@@ -1,0 +1,22 @@
+"""CommEfficient-TPU: a TPU-native federated-learning framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+tdye24/CommEfficient (FetchSGD): simulated cross-device federated
+learning with five client->server update modes (sketch, true_topk,
+local_topk, fedavg, uncompressed), error feedback, local/virtual
+momentum, differential privacy, non-IID partitioning, and per-client
+communication accounting.
+
+Where the reference runs one PyTorch process per GPU wired together with
+multiprocessing queues, POSIX shared memory and a NCCL reduce
+(reference: CommEfficient/fed_aggregator.py:137-164), this framework
+runs each federated round as a single jitted SPMD program over a
+`clients` mesh axis: participating clients are shards of a `shard_map`,
+the lone collective is `lax.psum` of the compressed update, and all
+mutable state (PS weights, momentum, error accumulators, per-client
+state) is explicit pytrees threaded through pure functions.
+"""
+
+__version__ = "0.1.0"
+
+from commefficient_tpu.config import Config, parse_args  # noqa: F401

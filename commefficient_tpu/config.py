@@ -1,0 +1,276 @@
+"""Configuration: the framework's single flat config namespace.
+
+Flag-name-parity with the reference CLI (reference:
+CommEfficient/utils.py:102-230 `parse_args`), so reference launch
+commands work unmodified, but held in a typed dataclass instead of a
+bare argparse namespace so it can be closed over as static jit config.
+
+Static/hashable by design: a `Config` is frozen and usable as a jit
+static argument; anything traced (learning rate, rng keys) is passed
+separately.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
+ERROR_TYPES = ("none", "local", "virtual")
+DP_MODES = ("worker", "server")
+
+# dataset -> num_classes (reference: utils.py:37-44); PERSONA is a
+# language-modeling dataset so has no class count.
+FED_DATASETS = {
+    "CIFAR10": 10,
+    "CIFAR100": 100,
+    "EMNIST": 62,
+    "ImageNet": 1000,
+    "PERSONA": -1,
+}
+
+# default client counts when --num_clients is unset
+# (reference: fed_aggregator.py:66-73)
+DEFAULT_NUM_CLIENTS = {
+    "EMNIST": 3500,
+    "PERSONA": 17568,
+}
+
+
+def num_classes_of_dataset(dataset_name: str) -> int:
+    return FED_DATASETS[dataset_name]
+
+
+@dataclass(frozen=True)
+class Config:
+    # meta (reference: utils.py:106-111)
+    do_test: bool = False
+    mode: str = "sketch"
+    use_tensorboard: bool = False
+    seed: int = 21
+
+    # data/model (utils.py:114-139)
+    model: str = "ResNet9"
+    do_finetune: bool = False
+    do_checkpoint: bool = False
+    checkpoint_path: str = "./checkpoint"
+    checkpoint_every: int = 0  # rounds between mid-run checkpoints; 0 = end only
+    resume: bool = False
+    finetune_path: str = "./finetune"
+    finetuned_from: Optional[str] = None
+    num_results_train: int = 2
+    num_results_val: int = 2
+    dataset_name: str = "CIFAR10"
+    dataset_dir: str = "./dataset"
+    do_batchnorm: bool = False
+    nan_threshold: float = 999.0
+
+    # compression (utils.py:142-147)
+    k: int = 50000
+    num_cols: int = 500000
+    num_rows: int = 5
+    num_blocks: int = 20
+    do_topk_down: bool = False
+
+    # optimization (utils.py:150-162)
+    local_momentum: float = 0.9
+    virtual_momentum: float = 0.0
+    weight_decay: float = 5e-4
+    num_epochs: float = 24.0
+    num_fedavg_epochs: int = 1
+    fedavg_batch_size: int = -1
+    fedavg_lr_decay: float = 1.0
+    error_type: str = "none"
+    lr_scale: Optional[float] = None
+    pivot_epoch: float = 5.0
+
+    # parallelization (utils.py:165-180). `port` kept for CLI parity but
+    # unused: there is no process-group rendezvous in a single-program
+    # SPMD runtime (reference needed it at fed_aggregator.py:161-164).
+    port: int = 5315
+    num_clients: Optional[int] = None
+    num_workers: int = 1
+    device: str = "tpu"
+    num_devices: int = 1
+    share_ps_gpu: bool = False
+    do_iid: bool = False
+    train_dataloader_workers: int = 0
+    val_dataloader_workers: int = 0
+
+    # GPT2 (utils.py:183-207)
+    model_checkpoint: str = "gpt2"
+    num_candidates: int = 2
+    max_history: int = 2
+    local_batch_size: int = 8
+    valid_batch_size: int = 8
+    microbatch_size: int = -1
+    lm_coef: float = 1.0
+    mc_coef: float = 1.0
+    max_grad_norm: Optional[float] = None
+    personality_permutations: int = 1
+    eval_before_start: bool = False
+
+    # differential privacy (utils.py:210-214)
+    do_dp: bool = False
+    dp_mode: str = "worker"
+    l2_norm_clip: float = 1.0
+    noise_multiplier: float = 0.0
+
+    # set after model construction (reference mutates args.grad_size at
+    # fed_aggregator.py:88; we return a new frozen Config instead)
+    grad_size: int = 0
+
+    # --- derived helpers -------------------------------------------------
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        """Shape of the transmitted/accumulated quantity for this mode
+        (reference: fed_aggregator.py:116-121,400-405)."""
+        if self.mode == "sketch":
+            return (self.num_rows, self.num_cols)
+        return (self.grad_size,)
+
+    @property
+    def upload_floats(self) -> int:
+        """Floats uploaded per participating client per round
+        (reference: fed_aggregator.py:291-299)."""
+        return {
+            "uncompressed": self.grad_size,
+            "true_topk": self.grad_size,
+            "local_topk": self.k,
+            "sketch": self.num_rows * self.num_cols,
+            "fedavg": self.grad_size,
+        }[self.mode]
+
+    def resolved_num_clients(self, dataset_num_clients: Optional[int] = None) -> int:
+        if self.num_clients is not None:
+            return self.num_clients
+        if dataset_num_clients is not None:
+            return dataset_num_clients
+        if self.dataset_name in DEFAULT_NUM_CLIENTS:
+            return DEFAULT_NUM_CLIENTS[self.dataset_name]
+        raise ValueError(
+            f"num_clients must be given for dataset {self.dataset_name}"
+        )
+
+    def validate(self) -> "Config":
+        """Config invariants; the scattered asserts of the reference
+        (utils.py:225-228, fed_aggregator.py:484-486,573-576,
+        fed_worker.py:62-63,221-228) centralized into one place."""
+        if self.mode not in MODES:
+            raise ValueError(f"unknown mode {self.mode}")
+        if self.error_type not in ERROR_TYPES:
+            raise ValueError(f"unknown error_type {self.error_type}")
+        if self.dp_mode not in DP_MODES:
+            raise ValueError(f"unknown dp_mode {self.dp_mode}")
+        if self.mode == "fedavg":
+            if self.local_batch_size != -1:
+                raise ValueError("fedavg requires local_batch_size == -1")
+            if self.local_momentum != 0:
+                raise ValueError("fedavg requires local_momentum == 0")
+            if self.error_type != "none":
+                raise ValueError("fedavg requires error_type == none")
+        if self.mode == "true_topk" and self.error_type != "virtual":
+            raise ValueError("true_topk requires error_type == virtual")
+        if self.mode == "local_topk" and self.error_type == "virtual":
+            raise ValueError("local_topk cannot use virtual error")
+        if self.mode == "sketch":
+            if self.error_type == "local" and self.virtual_momentum != 0:
+                raise ValueError("sketch+local error requires virtual_momentum=0")
+            if self.error_type == "virtual" and self.local_momentum != 0:
+                raise ValueError("sketch+virtual error requires local_momentum=0")
+            if self.error_type == "local":
+                raise ValueError(
+                    "sketch mode cannot use per-client local error accumulation "
+                    "(reference asserts this at fed_worker.py:221-222)"
+                )
+            if self.local_momentum != 0:
+                raise ValueError(
+                    "sketch mode cannot use local momentum "
+                    "(reference asserts this at fed_worker.py:227-228)"
+                )
+        if self.mode == "uncompressed" and self.error_type == "local":
+            raise ValueError(
+                "uncompressed cannot use local error accumulation "
+                "(reference asserts this at fed_worker.py:221-222)"
+            )
+        return self
+
+
+def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser:
+    """The reference CLI surface, flag for flag (utils.py:102-230)."""
+    p = argparse.ArgumentParser()
+    p.add_argument("--test", action="store_true", dest="do_test")
+    p.add_argument("--mode", choices=list(MODES), default="sketch")
+    p.add_argument("--tensorboard", dest="use_tensorboard", action="store_true")
+    p.add_argument("--seed", type=int, default=21)
+
+    p.add_argument("--model", default="ResNet9")
+    p.add_argument("--finetune", action="store_true", dest="do_finetune")
+    p.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
+    p.add_argument("--checkpoint_path", type=str, default="./checkpoint")
+    p.add_argument("--checkpoint_every", type=int, default=0)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--finetune_path", type=str, default="./finetune")
+    p.add_argument("--finetuned_from", type=str, choices=list(FED_DATASETS))
+    p.add_argument("--num_results_train", type=int, default=2)
+    p.add_argument("--num_results_val", type=int, default=2)
+    p.add_argument("--dataset_name", type=str, default="CIFAR10",
+                   choices=list(FED_DATASETS))
+    p.add_argument("--dataset_dir", type=str, default="./dataset")
+    p.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
+    p.add_argument("--nan_threshold", type=float, default=999)
+
+    p.add_argument("--k", type=int, default=50000)
+    p.add_argument("--num_cols", type=int, default=500000)
+    p.add_argument("--num_rows", type=int, default=5)
+    p.add_argument("--num_blocks", type=int, default=20)
+    p.add_argument("--topk_down", action="store_true", dest="do_topk_down")
+
+    p.add_argument("--local_momentum", type=float, default=0.9)
+    p.add_argument("--virtual_momentum", type=float, default=0)
+    p.add_argument("--weight_decay", type=float, default=5e-4)
+    p.add_argument("--num_epochs", type=float, default=24)
+    p.add_argument("--num_fedavg_epochs", type=int, default=1)
+    p.add_argument("--fedavg_batch_size", type=int, default=-1)
+    p.add_argument("--fedavg_lr_decay", type=float, default=1)
+    p.add_argument("--error_type", choices=list(ERROR_TYPES), default="none")
+    p.add_argument("--lr_scale", type=float, default=default_lr)
+    p.add_argument("--pivot_epoch", type=float, default=5)
+
+    p.add_argument("--port", type=int, default=5315)
+    p.add_argument("--num_clients", type=int)
+    p.add_argument("--num_workers", type=int, default=1)
+    p.add_argument("--device", type=str, default="tpu")
+    p.add_argument("--num_devices", type=int, default=1)
+    p.add_argument("--share_ps_gpu", action="store_true")
+    p.add_argument("--iid", action="store_true", dest="do_iid")
+    p.add_argument("--train_dataloader_workers", type=int, default=0)
+    p.add_argument("--val_dataloader_workers", type=int, default=0)
+
+    p.add_argument("--model_checkpoint", type=str, default="gpt2")
+    p.add_argument("--num_candidates", type=int, default=2)
+    p.add_argument("--max_history", type=int, default=2)
+    p.add_argument("--local_batch_size", type=int, default=8)
+    p.add_argument("--valid_batch_size", type=int, default=8)
+    p.add_argument("--microbatch_size", type=int, default=-1)
+    p.add_argument("--lm_coef", type=float, default=1.0)
+    p.add_argument("--mc_coef", type=float, default=1.0)
+    p.add_argument("--max_grad_norm", type=float)
+    p.add_argument("--personality_permutations", type=int, default=1)
+    p.add_argument("--eval_before_start", action="store_true")
+
+    p.add_argument("--dp", action="store_true", dest="do_dp")
+    p.add_argument("--dp_mode", choices=list(DP_MODES), default="worker")
+    p.add_argument("--l2_norm_clip", type=float, default=1.0)
+    p.add_argument("--noise_multiplier", type=float, default=0.0)
+    return p
+
+
+def parse_args(default_lr: Optional[float] = None, argv=None) -> Config:
+    ns = _build_parser(default_lr).parse_args(argv)
+    cfg = Config(**vars(ns))
+    return cfg.validate()

@@ -1,0 +1,8 @@
+from commefficient_tpu.ops.flat import (  # noqa: F401
+    flatten_params,
+    masked_topk,
+    clip_to_l2,
+    global_norm_clip,
+    dp_noise,
+)
+from commefficient_tpu.ops.sketch import CSVec, CSVecHashes  # noqa: F401

@@ -1,0 +1,82 @@
+"""Flat-parameter-vector substrate.
+
+The whole framework, like the reference, operates on a single flattened
+fp32 vector of all trainable parameters (reference:
+CommEfficient/utils.py:232-313 — `_topk`, `get_param_vec`,
+`set_param_vec`, `get_grad`, `clip_grad`). Here flattening is
+`jax.flatten_util.ravel_pytree` (one fused reshape/concat under jit, no
+per-parameter Python loop), and every op is a pure function usable
+inside `jit`/`shard_map`.
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def flatten_params(params) -> Tuple[jax.Array, Callable]:
+    """Flatten a parameter pytree to one fp32 vector.
+
+    Returns (vec, unravel) where unravel(vec) rebuilds the pytree
+    (replaces reference get_param_vec/set_param_vec,
+    utils.py:281-297).
+    """
+    vec, unravel = ravel_pytree(params)
+    return vec.astype(jnp.float32), unravel
+
+
+def masked_topk(vec: jax.Array, k: int) -> jax.Array:
+    """Dense vector equal to `vec` at its k largest-magnitude entries
+    and zero elsewhere (reference `_topk`, utils.py:232-252).
+
+    Works on 1-D [d] and batched 2-D [b, d] input (top-k taken per
+    row), like the reference.
+    """
+    def _topk_1d(v):
+        _, idx = jax.lax.top_k(v * v, k)
+        mask = jnp.zeros_like(v).at[idx].set(1.0)
+        return v * mask
+
+    if vec.ndim == 1:
+        return _topk_1d(vec)
+    elif vec.ndim == 2:
+        return jax.vmap(_topk_1d)(vec)
+    raise ValueError(f"masked_topk supports 1-D/2-D input, got {vec.ndim}-D")
+
+
+def clip_to_l2(vec: jax.Array, clip: float) -> jax.Array:
+    """Scale `vec` down to L2 norm `clip` if it exceeds it; identity
+    otherwise (reference `clip_grad`, utils.py:305-313). Unlike the
+    reference this is branch-free (jnp.where) so it traces under jit.
+    """
+    norm = jnp.linalg.norm(vec)
+    scale = jnp.where(norm > clip, clip / jnp.maximum(norm, 1e-30), 1.0)
+    return vec * scale
+
+
+def clip_table_to_l2(table: jax.Array, l2_est: jax.Array, clip: float) -> jax.Array:
+    """Clip a sketch table by an externally-supplied L2 estimate
+    (reference clips sketches via CSVec.l2estimate(),
+    utils.py:307-309)."""
+    scale = jnp.where(l2_est > clip, clip / jnp.maximum(l2_est, 1e-30), 1.0)
+    return table * scale
+
+
+def global_norm_clip(vec: jax.Array, max_norm: float) -> jax.Array:
+    """torch.nn.utils.clip_grad_norm_ semantics: multiply by
+    max_norm/(norm+1e-6) when norm exceeds max_norm (reference use:
+    fed_worker.py:290-292)."""
+    norm = jnp.linalg.norm(vec)
+    scale = jnp.where(norm > max_norm, max_norm / (norm + 1e-6), 1.0)
+    return vec * scale
+
+
+def dp_noise(key: jax.Array, shape, noise_multiplier: float,
+             scale: float = 1.0) -> jax.Array:
+    """Gaussian DP noise N(0, noise_multiplier) * scale (reference:
+    fed_worker.py:304-309 worker-side — scale=sqrt(num_workers);
+    fed_aggregator.py:505-508 server-side — scale=1)."""
+    return jax.random.normal(key, shape) * (noise_multiplier * scale)

@@ -1,0 +1,210 @@
+"""Count-sketch for gradient compression, TPU-native.
+
+Re-designs the capability the reference gets from the external `csvec`
+package (CSVec: github.com/nikitaivkin/csh; used at reference
+CommEfficient/fed_worker.py:312-320 and fed_aggregator.py:464-467,
+584-595): an r x c count-sketch of a length-d vector supporting
+linear accumulation, top-k heavy-hitter recovery, and L2 estimation.
+
+TPU-first design decisions:
+  * No stored hash index arrays (csvec materializes r*d hash tables on
+    the GPU and splits them into `numBlocks` chunks to fit memory).
+    Here bucket/sign hashes are *computed on the fly* from the
+    coordinate index with a murmur3-style integer mixer — pure uint32
+    VPU arithmetic, zero HBM footprint, and `num_blocks` degrades into
+    a pure scheduling knob (chunk count for the encode/decode scans)
+    that cannot change results.
+  * Encode is a blockwise `lax.scan` of scatter-adds; decode-top-k is
+    a blockwise `lax.scan` holding a running top-k buffer, so the d
+    median-estimates are never materialized at once (SURVEY.md §7.3
+    hard part #1: d = O(1e8) must not materialize).
+  * Everything is a pure function of (table, static hash params), so
+    sketches are linear by construction: psum of worker tables over
+    the client mesh axis == the sketch of the summed gradient. That
+    linearity is the whole point of FetchSGD, and it is what lets the
+    reference's lone NCCL reduce (fed_worker.py:138) become a single
+    `lax.psum` here.
+
+The sketch state is just a jnp array [r, c]; this class is a frozen,
+hashable bundle of static geometry + hash salts, safe to close over
+under jit.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_M32 = np.uint32(0xFFFFFFFF)
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """murmur3 finalizer: a fast, well-distributed uint32->uint32 mixer."""
+    x = x ^ (x >> 16)
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * np.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class CSVecHashes:
+    """Per-row hash salts, generated deterministically from `seed` so
+    that every participant (every client shard, and the server) builds
+    the identical sketch geometry — the analogue of csvec seeding its
+    hash generation with a fixed manual seed."""
+    bucket_salts: Tuple[int, ...]
+    sign_salts: Tuple[int, ...]
+
+    @staticmethod
+    def make(r: int, seed: int) -> "CSVecHashes":
+        rng = np.random.RandomState(seed)
+        return CSVecHashes(
+            bucket_salts=tuple(int(s) for s in rng.randint(1, 2**31, size=r)),
+            sign_salts=tuple(int(s) for s in rng.randint(1, 2**31, size=r)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CSVec:
+    """Count-sketch geometry: d-dim vectors into an [r, c] table.
+
+    API parity map with the reference's csvec.CSVec:
+      encode(v)                  ~ CSVec(...).accumulateVec(v); .table
+      (table arithmetic is just +)~ accumulateTable / zero()
+      decode_topk(table, k)      ~ unSketch(k=k)
+      l2estimate(table)          ~ l2estimate()
+    """
+    d: int
+    c: int
+    r: int
+    num_blocks: int = 1
+    seed: int = 42
+
+    def __post_init__(self):
+        object.__setattr__(self, "hashes", CSVecHashes.make(self.r, self.seed))
+
+    # --- hashing ---------------------------------------------------------
+    def hash_indices(self, idx: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Buckets [r, n] (int32 in [0, c)) and signs [r, n] (+-1 f32)
+        for an int32 index array [n]."""
+        iu = idx.astype(jnp.uint32)
+        buckets = []
+        signs = []
+        for j in range(self.r):
+            hb = _mix32(iu ^ np.uint32(self.hashes.bucket_salts[j]))
+            hs = _mix32(iu ^ np.uint32(self.hashes.sign_salts[j]))
+            buckets.append((hb % np.uint32(self.c)).astype(jnp.int32))
+            signs.append(1.0 - 2.0 * (hs & np.uint32(1)).astype(jnp.float32))
+        return jnp.stack(buckets), jnp.stack(signs)
+
+    # --- geometry helpers ------------------------------------------------
+    @property
+    def _chunk(self) -> int:
+        return -(-self.d // max(self.num_blocks, 1))
+
+    @property
+    def table_shape(self) -> Tuple[int, int]:
+        return (self.r, self.c)
+
+    def zeros(self) -> jax.Array:
+        return jnp.zeros(self.table_shape, jnp.float32)
+
+    # --- encode ----------------------------------------------------------
+    def encode(self, vec: jax.Array) -> jax.Array:
+        """Sketch a dense [d] vector into an [r, c] table."""
+        chunk = self._chunk
+        n_blocks = -(-self.d // chunk)
+        row_ids = jnp.repeat(jnp.arange(self.r, dtype=jnp.int32), chunk)
+
+        def body(table, b):
+            start = b * chunk
+            i = start + jnp.arange(chunk, dtype=jnp.int32)
+            valid = (i < self.d).astype(jnp.float32)
+            vals = jax.lax.dynamic_slice_in_dim(
+                self._padded(vec), start, chunk) * valid
+            buckets, signs = self.hash_indices(i)
+            contrib = (signs * vals[None, :]).reshape(-1)
+            table = table.at[row_ids, buckets.reshape(-1)].add(contrib)
+            return table, None
+
+        # init carry derived from `vec` (not a fresh constant) so that
+        # under shard_map the carry inherits vec's varying-axes type
+        init = jnp.zeros_like(vec, shape=self.table_shape)
+        table, _ = jax.lax.scan(
+            body, init, jnp.arange(n_blocks, dtype=jnp.int32))
+        return table
+
+    def _padded(self, vec: jax.Array) -> jax.Array:
+        chunk = self._chunk
+        n_blocks = -(-self.d // chunk)
+        pad = n_blocks * chunk - self.d
+        return jnp.pad(vec, (0, pad)) if pad else vec
+
+    def encode_sparse(self, indices: jax.Array, values: jax.Array) -> jax.Array:
+        """Sketch a sparse vector given as (indices [n], values [n]).
+        Out-of-range indices (e.g. i >= d padding) are dropped. Used by
+        the server's sketched error-feedback step, which re-sketches the
+        k-sparse recovered update (reference fed_aggregator.py:593-595)
+        — an O(k) scatter instead of an O(d) re-encode."""
+        buckets, signs = self.hash_indices(indices.astype(jnp.int32))
+        valid = ((indices >= 0) & (indices < self.d)).astype(jnp.float32)
+        vals = values * valid
+        row_ids = jnp.repeat(
+            jnp.arange(self.r, dtype=jnp.int32), indices.shape[0])
+        return self.zeros().at[
+            row_ids, buckets.reshape(-1)
+        ].add((signs * vals[None, :]).reshape(-1))
+
+    # --- decode ----------------------------------------------------------
+    def estimate(self, table: jax.Array, idx: jax.Array) -> jax.Array:
+        """Median-of-rows unbiased estimates of coordinates `idx` [n]."""
+        buckets, signs = self.hash_indices(idx.astype(jnp.int32))
+        ests = signs * table[jnp.arange(self.r)[:, None], buckets]  # [r, n]
+        return jnp.median(ests, axis=0)
+
+    def decode_topk(self, table: jax.Array, k: int) -> jax.Array:
+        """Dense [d] vector holding the k largest-magnitude estimated
+        coordinates (reference csvec unSketch(k)). Blockwise scan with
+        a running top-k buffer: never materializes all d estimates."""
+        sparse_idx, sparse_vals = self.decode_topk_sparse(table, k)
+        dense = jnp.zeros(self.d, jnp.float32)
+        return dense.at[sparse_idx].set(sparse_vals, mode="drop")
+
+    def decode_topk_sparse(
+        self, table: jax.Array, k: int
+    ) -> Tuple[jax.Array, jax.Array]:
+        """(indices [k], values [k]) of the top-k estimates. Unfilled
+        slots carry index d (out of range; dropped by `mode='drop'`
+        scatters downstream)."""
+        k = min(k, self.d)
+        chunk = self._chunk
+        n_blocks = -(-self.d // chunk)
+
+        def body(carry, b):
+            best_idx, best_vals = carry
+            start = b * chunk
+            i = start + jnp.arange(chunk, dtype=jnp.int32)
+            est = self.estimate(table, i)
+            est = jnp.where(i < self.d, est, 0.0)
+            cand_idx = jnp.concatenate([best_idx, i])
+            cand_vals = jnp.concatenate([best_vals, est])
+            _, sel = jax.lax.top_k(cand_vals * cand_vals, k)
+            return (cand_idx[sel], cand_vals[sel]), None
+
+        init = (jnp.full_like(table, self.d, dtype=jnp.int32, shape=(k,)),
+                jnp.zeros_like(table, shape=(k,)))
+        (idx, vals), _ = jax.lax.scan(
+            body, init, jnp.arange(n_blocks, dtype=jnp.int32))
+        return idx, vals
+
+    # --- norms -----------------------------------------------------------
+    def l2estimate(self, table: jax.Array) -> jax.Array:
+        """Estimated L2 norm of the sketched vector: median over rows of
+        per-row L2 (csvec l2estimate; used for clipping sketches at
+        reference utils.py:307-309)."""
+        return jnp.sqrt(jnp.median(jnp.sum(table * table, axis=1)))

@@ -1,0 +1,1 @@
+from commefficient_tpu.parallel.mesh import make_client_mesh  # noqa: F401

@@ -1,0 +1,39 @@
+"""Device mesh construction.
+
+The framework's distinctive parallel axis is `clients`: each device
+shard simulates a subset of the round's participating clients
+(SURVEY.md §2.10 — the reference's only parallelism is one worker
+process per GPU, fed_aggregator.py:143-158; here workers are shards).
+A second optional `model` axis supports tensor-parallel sharding of
+large models (GPT2-scale), mapped so `clients` rides the outer ICI
+dimension.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_client_mesh(num_client_shards: Optional[int] = None,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over the `clients` axis."""
+    devices = list(devices) if devices is not None else jax.devices()
+    n = num_client_shards or len(devices)
+    if n > len(devices):
+        raise ValueError(f"asked for {n} shards, have {len(devices)} devices")
+    return Mesh(np.asarray(devices[:n]), axis_names=("clients",))
+
+
+def make_client_model_mesh(num_client_shards: int, model_parallel: int,
+                           devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D (clients, model) mesh; model-parallel inner so its
+    collectives ride the fastest ICI links."""
+    devices = list(devices) if devices is not None else jax.devices()
+    need = num_client_shards * model_parallel
+    if need > len(devices):
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(num_client_shards, model_parallel)
+    return Mesh(arr, axis_names=("clients", "model"))

@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX import.
+
+Mirrors the reference's multi-GPU-only testability gap (SURVEY.md §4): the
+reference could only exercise its distributed path on a real multi-GPU box;
+here every sharded code path runs on host-emulated devices.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    from commefficient_tpu.parallel.mesh import make_client_mesh
+
+    return make_client_mesh(len(jax.devices()))

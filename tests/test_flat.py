@@ -1,0 +1,74 @@
+"""Golden tests for the flat-vector substrate (reference semantics:
+CommEfficient/utils.py:232-313)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.ops import flat
+
+
+def test_masked_topk_1d():
+    v = jnp.array([0.1, -5.0, 3.0, 0.0, -0.2, 4.0])
+    out = flat.masked_topk(v, 2)
+    np.testing.assert_allclose(out, [0, -5.0, 0, 0, 0, 4.0])
+
+
+def test_masked_topk_2d_per_row():
+    v = jnp.array([[1.0, -3.0, 2.0], [5.0, 0.5, -0.1]])
+    out = flat.masked_topk(v, 1)
+    np.testing.assert_allclose(out, [[0, -3.0, 0], [5.0, 0, 0]])
+
+
+def test_masked_topk_matches_sort():
+    rng = np.random.RandomState(0)
+    v = jnp.asarray(rng.randn(257).astype(np.float32))
+    k = 31
+    out = np.asarray(flat.masked_topk(v, k))
+    idx = np.argsort(np.asarray(v) ** 2)[-k:]
+    expected = np.zeros_like(v)
+    expected[idx] = np.asarray(v)[idx]
+    np.testing.assert_allclose(out, expected)
+
+
+def test_clip_to_l2_noop_below_threshold():
+    v = jnp.array([0.3, 0.4])  # norm 0.5
+    np.testing.assert_allclose(flat.clip_to_l2(v, 1.0), v)
+
+
+def test_clip_to_l2_scales_to_exactly_clip():
+    v = jnp.array([3.0, 4.0])  # norm 5
+    out = flat.clip_to_l2(v, 1.0)
+    np.testing.assert_allclose(jnp.linalg.norm(out), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(out, v / 5.0, rtol=1e-6)
+
+
+def test_global_norm_clip_torch_semantics():
+    v = jnp.array([3.0, 4.0])
+    out = flat.global_norm_clip(v, 2.0)
+    # torch multiplies by max_norm / (norm + 1e-6)
+    np.testing.assert_allclose(out, v * (2.0 / (5.0 + 1e-6)), rtol=1e-6)
+    np.testing.assert_allclose(flat.global_norm_clip(v, 10.0), v)
+
+
+def test_flatten_roundtrip():
+    params = {"a": jnp.ones((2, 3)), "b": {"w": jnp.arange(4.0)}}
+    vec, unravel = flat.flatten_params(params)
+    assert vec.shape == (10,)
+    back = unravel(vec)
+    np.testing.assert_allclose(back["a"], params["a"])
+    np.testing.assert_allclose(back["b"]["w"], params["b"]["w"])
+
+
+def test_dp_noise_stats():
+    key = jax.random.PRNGKey(0)
+    noise = flat.dp_noise(key, (20000,), noise_multiplier=2.0, scale=3.0)
+    assert abs(float(jnp.std(noise)) - 6.0) < 0.2
+    assert abs(float(jnp.mean(noise))) < 0.2
+
+
+def test_masked_topk_jits():
+    f = jax.jit(lambda v: flat.masked_topk(v, 3))
+    v = jnp.arange(10.0) - 5.0
+    out = f(v)
+    assert int((out != 0).sum()) == 3
