@@ -1,0 +1,260 @@
+"""Client-side computation: one simulated federated client's round.
+
+Functional re-design of the reference worker runtime's per-client math
+(reference: CommEfficient/fed_worker.py:140-335 — `process_batch`,
+`local_step`, `forward_grad` — and the fedavg local-SGD branch at
+:61-113). The reference runs this as a Python loop inside one process
+per GPU; here it is a pure function over static-shape arrays, designed
+to be `vmap`ed over the clients owned by a mesh shard and `shard_map`ed
+over the `clients` axis.
+
+Static-shape discipline (SURVEY.md §7.3 hard part #2): client batches
+are padded to [B] with a validity mask; microbatching is a `lax.scan`
+over a [n_mb, mb, ...] reshape; all means are masked means; the
+transmitted quantity is scaled by the *valid* example count, matching
+the reference's g *= batch_size (fed_worker.py:190) so the server's
+divide-by-total-batch-size (fed_aggregator.py:332) is exact.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.ops.flat import (
+    clip_to_l2, clip_table_to_l2, dp_noise, global_norm_clip, masked_topk,
+)
+from commefficient_tpu.ops.sketch import CSVec
+
+# loss_fn contract (the workload callback, analogous to the reference's
+# compute_loss(model, batch, args) -> (loss, *metrics) at
+# cv_train.py:67-83 / gpt2_train.py:77-99, extended with a validity
+# mask): loss_fn(params_pytree, batch_tuple, mask) ->
+#   (masked-mean loss, tuple of masked-mean metrics)
+LossFn = Callable[[object, Tuple[jax.Array, ...], jax.Array],
+                  Tuple[jax.Array, Tuple[jax.Array, ...]]]
+
+
+class ClientResult(NamedTuple):
+    transmit: jax.Array          # [D] vector or [r, c] sketch table
+    error: jax.Array             # updated local error state (or dummy)
+    velocity: jax.Array          # updated local velocity state (or dummy)
+    loss: jax.Array              # masked-mean loss over this client's batch
+    metrics: Tuple[jax.Array, ...]
+    num_examples: jax.Array      # valid example count (f32)
+
+
+def make_flat_grad_fn(loss_fn: LossFn, unravel: Callable):
+    """Lift a pytree loss into flat-vector space: the substrate every
+    compression op works in (replaces get_grad/get_grad_vec,
+    reference utils.py:254-273)."""
+    def flat_grad(weights_vec, batch, mask):
+        def scalar_loss(vec):
+            loss, metrics = loss_fn(unravel(vec), batch, mask)
+            return loss, metrics
+        (loss, metrics), grad = jax.value_and_grad(
+            scalar_loss, has_aux=True)(weights_vec)
+        return loss, metrics, grad
+    return flat_grad
+
+
+def _microbatch_shape(batch_size: int, microbatch_size: int) -> Tuple[int, int]:
+    mb = batch_size if microbatch_size <= 0 else min(microbatch_size, batch_size)
+    n_mb = -(-batch_size // mb)
+    return n_mb, mb
+
+
+def _reshape_microbatches(tree, mask, n_mb: int, mb: int):
+    """Pad [B, ...] arrays to n_mb*mb and fold into [n_mb, mb, ...]."""
+    B = mask.shape[0]
+    pad = n_mb * mb - B
+
+    def fold(x):
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+        return x.reshape((n_mb, mb) + x.shape[1:])
+
+    mask = jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)]) if pad else mask
+    return jax.tree.map(fold, tree), mask.reshape(n_mb, mb)
+
+
+def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
+                 cfg: Config, key: Optional[jax.Array] = None,
+                 compute_grad: bool = True):
+    """Microbatched forward(/backward) over one client's padded batch
+    (reference forward_grad, fed_worker.py:249-335).
+
+    Returns (g, loss, metrics, count): g is the per-mode compressed
+    mean-gradient ([D] vector, or [r, c] table for sketch); loss and
+    metrics are masked means over the batch; count is the number of
+    valid examples. g is None when compute_grad=False (eval path,
+    fed_worker.py:300-301).
+    """
+    B = mask.shape[0]
+    n_mb, mb = _microbatch_shape(B, cfg.microbatch_size)
+    mbatch, mmask = _reshape_microbatches(batch, mask, n_mb, mb)
+
+    def body(carry, xs):
+        accum_grad, accum_loss, accum_metrics = carry
+        b, m = xs
+        count = m.sum()
+        if compute_grad:
+            loss, metrics, grad = flat_grad_fn(weights, b, m)
+            accum_grad = accum_grad + grad * count
+        else:
+            loss, metrics = jax.lax.stop_gradient(
+                _eval_loss(flat_grad_fn, weights, b, m))
+        accum_loss = accum_loss + loss * count
+        accum_metrics = jax.tree.map(
+            lambda a, v: a + v * count, accum_metrics, metrics)
+        return (accum_grad, accum_loss, accum_metrics), None
+
+    # metric structure probe (abstract eval: shapes only, no FLOPs)
+    _, metrics_shape, _ = jax.eval_shape(
+        flat_grad_fn, weights,
+        jax.tree.map(lambda x: x[0], mbatch), mmask[0])
+    metrics_proto = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape)
+    init = (jnp.zeros_like(weights), jnp.zeros(()), metrics_proto)
+    (grad_sum, loss_sum, metric_sums), _ = jax.lax.scan(
+        body, init, (mbatch, mmask))
+
+    total = mask.sum()
+    denom = jnp.maximum(total, 1.0)
+    loss = loss_sum / denom
+    metrics = jax.tree.map(lambda m: m / denom, metric_sums)
+
+    if not compute_grad:
+        return None, loss, metrics, total
+
+    # weighted mean over valid examples: gradient scale is invariant to
+    # microbatch_size. (Deliberate divergence: the reference sums
+    # microbatch-mean grads, making scale depend on the microbatch
+    # count, and compensates by scaling the clip threshold by
+    # num_iters — fed_worker.py:286-292.)
+    grad = grad_sum / denom
+
+    # gradient clipping for non-sketch modes (reference
+    # fed_worker.py:290-292; unscaled here per the note above)
+    if cfg.max_grad_norm is not None and cfg.mode != "sketch":
+        grad = global_norm_clip(grad, cfg.max_grad_norm)
+
+    # weight decay folded into the gradient, divided by num_workers so
+    # the summed transmission applies it once (reference utils.py:254-259)
+    if cfg.weight_decay != 0:
+        grad = grad + (cfg.weight_decay / cfg.num_workers) * weights
+
+    # differential privacy at the worker (reference fed_worker.py:304-309)
+    if cfg.do_dp:
+        grad = clip_to_l2(grad, cfg.l2_norm_clip)
+        if cfg.dp_mode == "worker":
+            grad = grad + dp_noise(key, grad.shape, cfg.noise_multiplier,
+                                   scale=float(np.sqrt(cfg.num_workers)))
+
+    # per-mode compression (reference fed_worker.py:311-335)
+    if cfg.mode == "sketch":
+        sketch = CSVec(d=cfg.grad_size, c=cfg.num_cols, r=cfg.num_rows,
+                       num_blocks=cfg.num_blocks, seed=42)
+        table = sketch.encode(grad)
+        if cfg.max_grad_norm is not None:
+            table = clip_table_to_l2(
+                table, sketch.l2estimate(table), cfg.max_grad_norm)
+        g = table
+    else:
+        # true_topk / local_topk / fedavg / uncompressed all transmit
+        # the dense gradient here; sparsification happens later
+        # (server for true_topk; local_step for local_topk)
+        g = grad
+
+    return g, loss, metrics, total
+
+
+def _eval_loss(flat_grad_fn, weights, b, m):
+    # reuse the grad fn's closure without differentiating
+    loss, metrics, _ = flat_grad_fn(weights, b, m)
+    return loss, metrics
+
+
+def local_step(flat_grad_fn, weights, batch, mask, error, velocity,
+               cfg: Config, key=None) -> ClientResult:
+    """One client's single local step + compression bookkeeping
+    (reference local_step, fed_worker.py:184-230)."""
+    g, loss, metrics, count = forward_grad(
+        flat_grad_fn, weights, batch, mask, cfg, key)
+
+    # transmit sums over examples; server divides by the global batch
+    # size (reference fed_worker.py:190)
+    g = g * count
+
+    if cfg.local_momentum > 0:
+        velocity = g + cfg.local_momentum * velocity
+
+    if cfg.error_type == "local":
+        error = error + (velocity if cfg.local_momentum > 0 else g)
+        to_transmit = error
+    else:
+        to_transmit = velocity if cfg.local_momentum > 0 else g
+
+    if cfg.mode == "local_topk":
+        to_transmit = masked_topk(to_transmit, k=cfg.k)
+        not_sent = (to_transmit == 0).astype(g.dtype)
+        if cfg.error_type == "local":
+            error = error * not_sent           # error feedback
+        if cfg.local_momentum > 0:
+            velocity = velocity * not_sent     # momentum factor masking
+
+    return ClientResult(to_transmit, error, velocity, loss, metrics, count)
+
+
+def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
+                lr, key=None) -> ClientResult:
+    """FedAvg: full local SGD over the client's dataset, transmitting
+    the dataset-size-weighted weight delta (reference worker_loop
+    fedavg branch, fed_worker.py:61-113).
+
+    `batch` holds the client's entire local dataset padded to a static
+    size; it is split into fedavg_batch_size local batches and scanned
+    num_fedavg_epochs times with per-step lr decay fedavg_lr_decay**step.
+    """
+    B = mask.shape[0]
+    inner = B if cfg.fedavg_batch_size == -1 else min(cfg.fedavg_batch_size, B)
+    n_batches = -(-B // inner)
+    lbatch, lmask = _reshape_microbatches(batch, mask, n_batches, inner)
+
+    # one scan over epochs * n_batches steps
+    steps = cfg.num_fedavg_epochs * n_batches
+    step_batch = jax.tree.map(
+        lambda x: jnp.tile(x, (cfg.num_fedavg_epochs,) + (1,) * (x.ndim - 1)),
+        lbatch)
+    step_mask = jnp.tile(lmask, (cfg.num_fedavg_epochs, 1))
+
+    def body(carry, xs):
+        w, step = carry
+        b, m = xs
+        count = jnp.maximum(m.sum(), 1.0)
+        loss, metrics, grad = flat_grad_fn(w, b, m)
+        # reference computes sum-grad then divides by batch size
+        # (fed_worker.py:96-98); our flat_grad_fn already returns the
+        # masked-mean gradient, but weight decay must still be added
+        if cfg.weight_decay != 0:
+            grad = grad + (cfg.weight_decay / cfg.num_workers) * w
+        decay = cfg.fedavg_lr_decay ** step
+        w = w - grad * lr * decay
+        return (w, step + 1.0), (loss, metrics)
+
+    (w_final, _), (losses, metrics_seq) = jax.lax.scan(
+        body, (weights, jnp.zeros(())), (step_batch, step_mask))
+
+    # metrics averaged over local steps (reference fed_worker.py:102-103)
+    loss = losses.mean()
+    metrics = jax.tree.map(lambda m: m.mean(), metrics_seq)
+
+    count = mask.sum()
+    delta = (weights - w_final) * count  # dataset-size weighting (:104-108)
+    dummy = jnp.zeros((), weights.dtype)
+    return ClientResult(delta, dummy, dummy, loss, metrics, count)
