@@ -118,9 +118,13 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
     _, metrics_shape, _ = jax.eval_shape(
         flat_grad_fn, weights,
         jax.tree.map(lambda x: x[0], mbatch), mmask[0])
+    # scan carries seeded from `mask` (not fresh constants) so that
+    # under shard_map they inherit the data's varying-axes type
+    zero = jnp.zeros_like(mask, shape=())
     metrics_proto = jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape)
-    init = (jnp.zeros_like(weights), jnp.zeros(()), metrics_proto)
+        lambda s: jnp.zeros(s.shape, s.dtype) + zero.astype(s.dtype),
+        metrics_shape)
+    init = (jnp.zeros_like(weights) + zero, zero, metrics_proto)
     (grad_sum, loss_sum, metric_sums), _ = jax.lax.scan(
         body, init, (mbatch, mmask))
 
@@ -247,8 +251,9 @@ def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
         w = w - grad * lr * decay
         return (w, step + 1.0), (loss, metrics)
 
+    zero = jnp.zeros_like(mask, shape=())
     (w_final, _), (losses, metrics_seq) = jax.lax.scan(
-        body, (weights, jnp.zeros(())), (step_batch, step_mask))
+        body, (weights + zero, zero), (step_batch, step_mask))
 
     # metrics averaged over local steps (reference fed_worker.py:102-103)
     loss = losses.mean()
@@ -256,5 +261,5 @@ def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
 
     count = mask.sum()
     delta = (weights - w_final) * count  # dataset-size weighting (:104-108)
-    dummy = jnp.zeros((), weights.dtype)
+    dummy = jnp.zeros_like(mask, shape=())
     return ClientResult(delta, dummy, dummy, loss, metrics, count)

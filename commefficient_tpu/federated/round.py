@@ -1,0 +1,261 @@
+"""The federated round engine: one round = one jitted SPMD program.
+
+This module is the TPU-native fusion of the reference's entire process
+topology (reference: CommEfficient/fed_aggregator.py:213-335 `_call_train`
++ fed_worker.py:14-138 `worker_loop` + fed_aggregator.py:429-458
+`FedOptimizer.step`). The reference needs three communication planes —
+multiprocessing queues for batch dispatch, POSIX shared memory for PS
+weights and per-client state, and a NCCL sum-reduce of the compressed
+update (SURVEY.md §1). Here all three collapse into one `shard_map`
+over the `clients` mesh axis:
+
+  * batch dispatch        -> sharded batch arrays, P('clients')
+  * shared-memory weights -> replicated ps_weights operand, P()
+  * NCCL reduce           -> `lax.psum` of the compressed quantity
+
+Per-client persistent state (errors/velocities/stale weights,
+reference fed_aggregator.py:105-129) lives as [num_clients, ...] device
+arrays; participant rows are gathered before the shard_map and
+scattered back after — the gather/scatter pattern called out as hard
+part #3 in SURVEY.md §7.3.
+
+True-top-k momentum factor masking of client velocities — broken in
+the reference via an unset global (SURVEY.md §7.4 D6) — is just data
+flow here: the server helper returns a mask, and the round engine
+applies it to the participating rows in the same jitted program.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated import client as fclient
+from commefficient_tpu.federated import server as fserver
+from commefficient_tpu.ops.flat import masked_topk
+
+
+class ServerState(NamedTuple):
+    """All PS-side mutable state (reference globals g_ps_weights /
+    FedOptimizer.Vvelocity / .Verror, fed_aggregator.py:37-44,408-409)."""
+    ps_weights: jax.Array        # [D] replicated
+    Vvelocity: jax.Array         # [D] or [r, c]
+    Verror: jax.Array            # [D] or [r, c]
+    round_idx: jax.Array         # scalar int32
+
+
+class ClientState(NamedTuple):
+    """Per-client persistent state, [num_clients, ...] rows (reference
+    shared-memory arrays at fed_aggregator.py:105-129). Fields are
+    zero-size placeholders when the config doesn't need them."""
+    errors: jax.Array            # [num_clients, D] or [0]
+    velocities: jax.Array        # [num_clients, D] or [0]
+    weights: jax.Array           # [num_clients, D] (topk_down) or [0]
+
+
+class RoundBatch(NamedTuple):
+    """One round's input: `num_workers` participating clients, each
+    with a padded local batch (static shapes; SURVEY.md §7.3 #2)."""
+    client_ids: jax.Array        # [num_workers] int32
+    data: Tuple[jax.Array, ...]  # pytree of [num_workers, B, ...]
+    mask: jax.Array              # [num_workers, B] f32 validity
+
+
+class RoundMetrics(NamedTuple):
+    losses: jax.Array            # [num_workers] per-client mean loss
+    metrics: Tuple[jax.Array, ...]  # per-client means, each [num_workers]
+    num_examples: jax.Array      # [num_workers]
+
+
+def init_server_state(cfg: Config, ps_weights: jax.Array) -> ServerState:
+    shape = cfg.state_shape
+    return ServerState(
+        ps_weights=ps_weights.astype(jnp.float32),
+        Vvelocity=jnp.zeros(shape, jnp.float32),
+        Verror=jnp.zeros(shape, jnp.float32),
+        round_idx=jnp.zeros((), jnp.int32),
+    )
+
+
+def init_client_state(cfg: Config, num_clients: int,
+                      ps_weights: Optional[jax.Array] = None,
+                      mesh: Optional[Mesh] = None) -> ClientState:
+    """Allocate per-client state rows (sharded over the mesh's clients
+    axis when a mesh is given, since at 17K+ clients these arrays are
+    the memory hazard — SURVEY.md §7.0)."""
+    D = cfg.grad_size
+    empty = jnp.zeros((0,), jnp.float32)
+
+    def alloc(shape):
+        arr = jnp.zeros(shape, jnp.float32)
+        if mesh is not None:
+            arr = jax.device_put(
+                arr, NamedSharding(mesh, P("clients", None)))
+        return arr
+
+    errors = alloc((num_clients, D)) if cfg.error_type == "local" else empty
+    velocities = (alloc((num_clients, D)) if cfg.local_momentum > 0
+                  else empty)
+    if cfg.do_topk_down:
+        assert ps_weights is not None
+        weights = jnp.broadcast_to(ps_weights, (num_clients, D)).copy()
+        if mesh is not None:
+            weights = jax.device_put(
+                weights, NamedSharding(mesh, P("clients", None)))
+    else:
+        weights = empty
+    return ClientState(errors, velocities, weights)
+
+
+def _has_errors(cfg): return cfg.error_type == "local"
+def _has_velocities(cfg): return cfg.local_momentum > 0
+
+
+def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
+                   cfg: Config, mesh: Mesh):
+    """Build the jitted train-round and eval functions.
+
+    loss_fn(params_pytree, batch_tuple, mask) -> (loss, metrics_tuple)
+    is the workload callback — the API contract preserved from the
+    reference (SURVEY.md §3.5): FedModel(model, compute_loss, args).
+    """
+    cfg.validate()
+    flat_grad = fclient.make_flat_grad_fn(loss_fn, unravel)
+    n_shards = mesh.devices.size
+
+    # ---------------- per-shard client phase ----------------------------
+    def shard_train(ps_weights, data, mask, err_rows, vel_rows, w_rows,
+                    keys, lr):
+        """Runs on one shard: simulate W = num_workers/n_shards clients
+        (vmap), locally sum their compressed updates, psum across the
+        clients axis (the reference's per-GPU client loop
+        fed_worker.py:60-131 + NCCL reduce :138)."""
+
+        def one_client(cdata, cmask, err, vel, w_stale, key):
+            if cfg.do_topk_down:
+                # download compression: client only receives the top-k
+                # of its weight staleness gap (fed_worker.py:232-247)
+                diff = ps_weights - w_stale
+                weights = w_stale + masked_topk(diff, k=cfg.k)
+            else:
+                weights = ps_weights
+
+            if cfg.mode == "fedavg":
+                res = fclient.fedavg_step(
+                    flat_grad, weights, cdata, cmask, cfg, lr, key)
+            else:
+                res = fclient.local_step(
+                    flat_grad, weights, cdata, cmask, err, vel, cfg, key)
+            new_w = (weights if cfg.do_topk_down
+                     else jnp.zeros_like(cmask, shape=()))
+            return res, new_w
+
+        results, new_w_rows = jax.vmap(one_client)(
+            data, mask, err_rows, vel_rows, w_rows, keys)
+
+        local_sum = jax.tree.map(lambda t: t.sum(axis=0), results.transmit)
+        transmit = jax.lax.psum(local_sum, "clients")
+        total = jax.lax.psum(results.num_examples.sum(), "clients")
+        return (transmit, total, results.error, results.velocity,
+                new_w_rows, results.loss, results.metrics,
+                results.num_examples)
+
+    state_spec = P("clients")
+
+    shard_train_mapped = shard_map(
+        shard_train, mesh=mesh,
+        in_specs=(P(), P("clients"), P("clients"), P("clients"),
+                  P("clients"), P("clients"), P("clients"), P()),
+        out_specs=(P(), P(), state_spec, state_spec, state_spec,
+                   P("clients"), P("clients"), P("clients")),
+    )
+
+    # ---------------- full train round ----------------------------------
+    @jax.jit
+    def train_round(server: ServerState, clients: ClientState,
+                    batch: RoundBatch, lr, key):
+        num_workers = batch.client_ids.shape[0]
+        if num_workers % n_shards != 0:
+            raise ValueError(
+                f"num_workers={num_workers} must be divisible by the "
+                f"{n_shards}-way clients mesh axis")
+        D = cfg.grad_size
+
+        # gather participant rows of persistent client state
+        ids = batch.client_ids
+        err_rows = (clients.errors[ids] if _has_errors(cfg)
+                    else jnp.zeros((num_workers,)))
+        vel_rows = (clients.velocities[ids] if _has_velocities(cfg)
+                    else jnp.zeros((num_workers,)))
+        w_rows = (clients.weights[ids] if cfg.do_topk_down
+                  else jnp.zeros((num_workers,)))
+
+        round_key = jax.random.fold_in(key, server.round_idx)
+        client_keys = jax.vmap(
+            lambda i: jax.random.fold_in(round_key, i)
+        )(jnp.arange(num_workers))
+
+        (transmit, total, new_err, new_vel, new_w, losses, metrics,
+         counts) = shard_train_mapped(
+            server.ps_weights, batch.data, batch.mask,
+            err_rows, vel_rows, w_rows, client_keys, lr)
+
+        # mean over the global batch (reference fed_aggregator.py:332)
+        gradient = transmit / jnp.maximum(total, 1.0)
+
+        # server aggregation + decompression
+        upd = fserver.get_server_update(
+            gradient, server.Vvelocity, server.Verror, cfg, lr,
+            key=jax.random.fold_in(round_key, num_workers))
+
+        new_ps = server.ps_weights - upd.update
+        new_server = ServerState(new_ps, upd.Vvelocity, upd.Verror,
+                                 server.round_idx + 1)
+
+        # scatter updated participant rows back
+        new_clients = clients
+        if _has_errors(cfg):
+            new_clients = new_clients._replace(
+                errors=new_clients.errors.at[ids].set(new_err))
+        if _has_velocities(cfg):
+            if upd.velocity_mask is not None:
+                # true_topk momentum factor masking (fixes ref D6)
+                new_vel = new_vel * upd.velocity_mask[None, :]
+            new_clients = new_clients._replace(
+                velocities=new_clients.velocities.at[ids].set(new_vel))
+        if cfg.do_topk_down:
+            # persist each participant's post-download weights so its
+            # staleness is tracked (the reference computes but never
+            # stores these — deliberate fix, see module docstring)
+            new_clients = new_clients._replace(
+                weights=new_clients.weights.at[ids].set(new_w))
+
+        return new_server, new_clients, RoundMetrics(losses, metrics, counts)
+
+    # ---------------- eval ----------------------------------------------
+    def shard_eval(ps_weights, data, mask):
+        def one_shard(b, m):
+            _, loss, metrics, count = fclient.forward_grad(
+                flat_grad, ps_weights, b, m, cfg, compute_grad=False)
+            return loss, metrics, count
+        return jax.vmap(one_shard)(data, mask)
+
+    shard_eval_mapped = shard_map(
+        shard_eval, mesh=mesh,
+        in_specs=(P(), P("clients"), P("clients")),
+        out_specs=(P("clients"), P("clients"), P("clients")),
+    )
+
+    @jax.jit
+    def eval_batch(ps_weights, data, mask):
+        """data: [S, vb, ...], mask: [S, vb]; S divisible by the mesh.
+        Returns per-shard (loss, metrics, count) — the val path of
+        reference _call_val (fed_aggregator.py:337-364)."""
+        return shard_eval_mapped(ps_weights, data, mask)
+
+    return train_round, eval_batch

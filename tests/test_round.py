@@ -1,0 +1,183 @@
+"""Round-engine integration tests on an 8-device virtual CPU mesh —
+the full train path the reference could only exercise on a multi-GPU
+box (SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated.round import (
+    RoundBatch, init_client_state, init_server_state, make_round_fns,
+)
+from commefficient_tpu.ops.flat import flatten_params
+
+D = 8  # parameter count
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    acc = ((jnp.abs(pred - y) < 0.5) * mask).sum() / denom
+    return loss, (acc,)
+
+
+def make_problem(seed=0, num_workers=8, B=4):
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(D).astype(np.float32)
+    x = rng.randn(num_workers, B, D).astype(np.float32)
+    y = np.einsum("wbd,d->wb", x, w_true).astype(np.float32)
+    return w_true, jnp.asarray(x), jnp.asarray(y)
+
+
+def setup(mesh, mode="uncompressed", num_workers=8, **kw):
+    params = {"w": jnp.zeros(D)}
+    vec, unravel = flatten_params(params)
+    base = dict(mode=mode, grad_size=D, weight_decay=0.0, num_workers=num_workers,
+                local_momentum=0.0, virtual_momentum=0.0, error_type="none",
+                microbatch_size=-1, num_clients=num_workers)
+    base.update(kw)
+    cfg = Config(**base)
+    train_round, eval_batch = make_round_fns(loss_fn, unravel, cfg, mesh)
+    server = init_server_state(cfg, vec)
+    clients = init_client_state(cfg, base["num_clients"], vec, mesh=None)
+    return cfg, train_round, eval_batch, server, clients
+
+
+def test_uncompressed_round_closed_form(mesh):
+    cfg, train_round, _, server, clients = setup(mesh)
+    _, x, y = make_problem()
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                       jnp.ones((8, 4)))
+    key = jax.random.PRNGKey(0)
+    new_server, _, metrics = train_round(server, clients, batch, 0.1, key)
+    # expected: w -= lr * mean-over-all-32-examples grad
+    xs = np.asarray(x).reshape(-1, D)
+    ys = np.asarray(y).reshape(-1)
+    grad = (xs * (xs @ np.zeros(D) - ys)[:, None]).mean(0)
+    np.testing.assert_allclose(
+        new_server.ps_weights, -0.1 * grad, rtol=1e-4, atol=1e-5)
+    assert metrics.losses.shape == (8,)
+    assert metrics.num_examples.sum() == 32
+
+
+def test_sketch_exact_regime_matches_uncompressed(mesh):
+    # k = D and exact decode -> sketched round == uncompressed round
+    _, x, y = make_problem()
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                       jnp.ones((8, 4)))
+    key = jax.random.PRNGKey(0)
+
+    cfg_u, tr_u, _, sv_u, cl_u = setup(mesh, "uncompressed")
+    s_u, _, _ = tr_u(sv_u, cl_u, batch, 0.1, key)
+
+    cfg_s, tr_s, _, sv_s, cl_s = setup(
+        mesh, "sketch", k=D, num_rows=5, num_cols=512, num_blocks=1,
+        error_type="virtual")
+    s_s, _, _ = tr_s(sv_s, cl_s, batch, 0.1, key)
+
+    np.testing.assert_allclose(
+        s_s.ps_weights, s_u.ps_weights, rtol=1e-3, atol=1e-5)
+
+
+def test_local_topk_full_k_matches_uncompressed(mesh):
+    _, x, y = make_problem()
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                       jnp.ones((8, 4)))
+    key = jax.random.PRNGKey(0)
+    _, tr_u, _, sv_u, cl_u = setup(mesh, "uncompressed")
+    s_u, _, _ = tr_u(sv_u, cl_u, batch, 0.1, key)
+    _, tr_l, _, sv_l, cl_l = setup(mesh, "local_topk", k=D,
+                                   error_type="local")
+    s_l, _, _ = tr_l(sv_l, cl_l, batch, 0.1, key)
+    np.testing.assert_allclose(
+        s_l.ps_weights, s_u.ps_weights, rtol=1e-4, atol=1e-6)
+
+
+def test_client_error_state_roundtrip(mesh):
+    # local_topk with k=1: unsent residuals persist per client
+    cfg, train_round, _, server, clients = setup(
+        mesh, "local_topk", k=1, error_type="local", num_clients=16)
+    _, x, y = make_problem()
+    ids = jnp.arange(8, dtype=jnp.int32) * 2  # clients 0,2,...,14
+    batch = RoundBatch(ids, (x, y), jnp.ones((8, 4)))
+    key = jax.random.PRNGKey(0)
+    _, new_clients, _ = train_round(server, clients, batch, 0.1, key)
+    errs = np.asarray(new_clients.errors)
+    # participating rows have D-1 nonzero residual coords (k=1 sent)
+    for cid in range(16):
+        nz = np.count_nonzero(errs[cid])
+        if cid % 2 == 0:
+            assert nz == D - 1, f"client {cid}: {nz}"
+        else:
+            assert nz == 0
+
+
+def test_fedavg_round_moves_weights(mesh):
+    cfg, train_round, _, server, clients = setup(
+        mesh, "fedavg", local_batch_size=-1, fedavg_batch_size=2,
+        num_fedavg_epochs=2, virtual_momentum=0.9)
+    _, x, y = make_problem()
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                       jnp.ones((8, 4)))
+    new_server, _, metrics = train_round(
+        server, clients, batch, 0.05, jax.random.PRNGKey(0))
+    assert float(jnp.abs(new_server.ps_weights).sum()) > 0
+    assert np.all(np.isfinite(np.asarray(metrics.losses)))
+
+
+def test_training_converges_sketch(mesh):
+    w_true, x, y = make_problem(seed=3)
+    cfg, train_round, eval_batch, server, clients = setup(
+        mesh, "sketch", k=D, num_rows=5, num_cols=256, num_blocks=1,
+        error_type="virtual", virtual_momentum=0.9)
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                       jnp.ones((8, 4)))
+    key = jax.random.PRNGKey(1)
+    for i in range(60):
+        server, clients, m = train_round(server, clients, batch, 0.05, key)
+    final_loss = float(m.losses.mean())
+    assert final_loss < 0.02, final_loss
+    np.testing.assert_allclose(server.ps_weights, w_true, atol=0.3)
+
+
+def test_training_converges_true_topk_with_local_momentum(mesh):
+    w_true, x, y = make_problem(seed=4)
+    cfg, train_round, _, server, clients = setup(
+        mesh, "true_topk", k=3, error_type="virtual",
+        local_momentum=0.5, num_clients=8)
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                       jnp.ones((8, 4)))
+    key = jax.random.PRNGKey(1)
+    for i in range(150):
+        server, clients, m = train_round(server, clients, batch, 0.05, key)
+    assert float(m.losses.mean()) < 0.05
+    # velocity state exists and was masked at least somewhere
+    assert clients.velocities.shape == (8, D)
+
+
+def test_eval_batch(mesh):
+    cfg, _, eval_batch, server, clients = setup(mesh)
+    _, x, y = make_problem()
+    loss, (acc,), count = eval_batch(server.ps_weights, (x, y),
+                                     jnp.ones((8, 4)))
+    assert loss.shape == (8,)
+    assert acc.shape == (8,)
+    np.testing.assert_allclose(count, 4.0 * np.ones(8))
+
+
+def test_topk_down_weight_staleness(mesh):
+    cfg, train_round, _, server, clients = setup(
+        mesh, "uncompressed", do_topk_down=True, k=2, num_clients=8)
+    assert clients.weights.shape == (8, D)
+    _, x, y = make_problem()
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                       jnp.ones((8, 4)))
+    s1, c1, _ = train_round(server, clients, batch, 0.1,
+                            jax.random.PRNGKey(0))
+    # after round 1, stored client weights differ from fresh PS weights
+    # by at most the non-top-k staleness gap
+    assert c1.weights.shape == (8, D)
