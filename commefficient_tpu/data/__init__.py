@@ -1,0 +1,7 @@
+from commefficient_tpu.data.fed_dataset import FedDataset  # noqa: F401
+from commefficient_tpu.data.sampler import (  # noqa: F401
+    FedSampler, ValSampler, RoundIndices,
+)
+from commefficient_tpu.data.loader import FedLoader, FedValLoader  # noqa: F401
+from commefficient_tpu.data.cifar import FedCIFAR10, FedCIFAR100  # noqa: F401
+from commefficient_tpu.data import transforms  # noqa: F401

@@ -1,0 +1,145 @@
+"""Federated CIFAR10/CIFAR100.
+
+Capability parity with the reference (reference:
+CommEfficient/data_utils/fed_cifar.py): the train set is partitioned
+into one natural unit per class — label == natural client id
+(reference fed_cifar.py:77-84) — and resharded over `num_clients` by
+FedDataset.data_per_client; the val set is flat.
+
+Sources, in order of preference:
+  1. the standard CIFAR python pickle batches under dataset_dir
+     (cifar-10-batches-py / cifar-100-python), if present on disk;
+  2. a deterministic synthetic substitute (class-dependent Gaussian
+     blobs) sized by `synthetic_examples` — this environment has no
+     network egress, and tests/benchmarks need data with the real
+     shapes and a learnable class signal.
+
+Storage: one .npy per class (the reference's layout choice,
+fed_cifar.py:45-58) under <dataset_dir>/<name>/.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Tuple
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+
+def _try_load_cifar_pickles(root: str, name: str):
+    """Read the standard CIFAR batch pickles if present."""
+    if name == "CIFAR10":
+        d = os.path.join(root, "cifar-10-batches-py")
+        if not os.path.isdir(d):
+            return None
+        xs, ys = [], []
+        for i in range(1, 6):
+            with open(os.path.join(d, f"data_batch_{i}"), "rb") as f:
+                b = pickle.load(f, encoding="bytes")
+            xs.append(b[b"data"])
+            ys.extend(b[b"labels"])
+        with open(os.path.join(d, "test_batch"), "rb") as f:
+            tb = pickle.load(f, encoding="bytes")
+        train = (np.concatenate(xs), np.array(ys))
+        test = (np.asarray(tb[b"data"]), np.array(tb[b"labels"]))
+    else:
+        d = os.path.join(root, "cifar-100-python")
+        if not os.path.isdir(d):
+            return None
+        with open(os.path.join(d, "train"), "rb") as f:
+            b = pickle.load(f, encoding="bytes")
+        train = (np.asarray(b[b"data"]), np.array(b[b"fine_labels"]))
+        with open(os.path.join(d, "test"), "rb") as f:
+            b = pickle.load(f, encoding="bytes")
+        test = (np.asarray(b[b"data"]), np.array(b[b"fine_labels"]))
+
+    def to_nhwc(x):
+        return x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+
+    return (to_nhwc(train[0]), train[1]), (to_nhwc(test[0]), test[1])
+
+
+def _synthetic_cifar(num_classes: int, n_train: int, n_val: int, seed: int):
+    """Deterministic class-separable images: per-class mean pattern +
+    noise. Gives smoke/bench runs a learnable signal."""
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(num_classes, 32, 32, 3).astype(np.float32)
+
+    def gen(n):
+        labels = rng.randint(0, num_classes, size=n)
+        noise = rng.rand(n, 32, 32, 3).astype(np.float32)
+        imgs = 0.6 * protos[labels] + 0.4 * noise
+        return (imgs * 255).astype(np.uint8), labels.astype(np.int64)
+
+    return gen(n_train), gen(n_val)
+
+
+class FedCIFAR10(FedDataset):
+    num_classes = 10
+
+    def __init__(self, dataset_dir, dataset_name="CIFAR10", transform=None,
+                 do_iid=False, num_clients=None, train=True, download=False,
+                 synthetic_examples: Optional[Tuple[int, int]] = None,
+                 seed: int = 0):
+        self._synthetic_examples = synthetic_examples
+        self._seed = seed
+        super().__init__(dataset_dir, dataset_name, transform, do_iid,
+                         num_clients, train, download, seed)
+        self._cache = {}
+
+    def _dir(self):
+        return os.path.join(self.dataset_dir, self.dataset_name)
+
+    def prepare(self, download: bool = False):
+        loaded = _try_load_cifar_pickles(self.dataset_dir,
+                                         self.dataset_name)
+        if loaded is None:
+            if self._synthetic_examples is None:
+                raise FileNotFoundError(
+                    f"No {self.dataset_name} archives under "
+                    f"{self.dataset_dir} and no network egress; pass "
+                    f"synthetic_examples=(n_train, n_val) to generate "
+                    f"synthetic data")
+            n_train, n_val = self._synthetic_examples
+            (xtr, ytr), (xva, yva) = _synthetic_cifar(
+                self.num_classes, n_train, n_val, self._seed)
+        else:
+            (xtr, ytr), (xva, yva) = loaded
+
+        os.makedirs(self._dir(), exist_ok=True)
+        images_per_client = []
+        for c in range(self.num_classes):
+            sel = ytr == c
+            np.save(os.path.join(self._dir(), f"client{c}.npy"), xtr[sel])
+            images_per_client.append(int(sel.sum()))
+        np.savez(os.path.join(self._dir(), "val.npz"),
+                 images=xva, labels=yva)
+        self.write_stats(images_per_client, len(yva))
+
+    def _client_images(self, cid: int) -> np.ndarray:
+        if cid not in self._cache:
+            self._cache[cid] = np.load(
+                os.path.join(self._dir(), f"client{cid}.npy"))
+        return self._cache[cid]
+
+    def _get_train_batch(self, nat_client_id: int, idxs: np.ndarray):
+        imgs = self._client_images(nat_client_id)[idxs]
+        # label == natural client id (reference fed_cifar.py:77-84)
+        labels = np.full(len(idxs), nat_client_id, np.int64)
+        return imgs, labels
+
+    def _get_val_batch(self, idxs: np.ndarray):
+        if "val" not in self._cache:
+            z = np.load(os.path.join(self._dir(), "val.npz"))
+            self._cache["val"] = (z["images"], z["labels"])
+        imgs, labels = self._cache["val"]
+        return imgs[idxs], labels[idxs]
+
+
+class FedCIFAR100(FedCIFAR10):
+    num_classes = 100
+
+    def __init__(self, dataset_dir, dataset_name="CIFAR100", **kw):
+        super().__init__(dataset_dir, dataset_name, **kw)

@@ -1,0 +1,166 @@
+"""Federated dataset base: client partitioning + metadata.
+
+Capability parity with the reference data layer's core abstractions
+(reference: CommEfficient/data_utils/fed_dataset.py — flat-index ->
+(client_id, datum) mapping at :68-95, `data_per_client` at :31-48,
+`stats.json` metadata at :55-59,97-98; non-IID natural partitions and
+IID reshuffle at :28-29,71-75).
+
+Host-side numpy only — the TPU program never sees ragged structures;
+`commefficient_tpu.data.sampler` turns this into padded, static-shape
+round batches.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class FedDataset:
+    """Base class: a train corpus partitioned over clients, plus a flat
+    validation set.
+
+    Subclasses implement `prepare()` (fill `self.images_per_client`,
+    `self.num_val_images`, and storage) and the two fetchers
+    `_get_train_batch(client_id, idxs)` / `_get_val_batch(idxs)`, each
+    returning a tuple of stacked numpy arrays.
+    """
+
+    def __init__(self, dataset_dir: str, dataset_name: str,
+                 transform=None, do_iid: bool = False,
+                 num_clients: Optional[int] = None, train: bool = True,
+                 download: bool = False, seed: int = 0):
+        self.dataset_dir = dataset_dir
+        self.dataset_name = dataset_name
+        self.transform = transform
+        self.do_iid = do_iid
+        self._num_clients = num_clients
+        self.train = train
+
+        if not do_iid and num_clients == 1:
+            raise ValueError("can't have 1 client when non-iid")
+
+        if not os.path.exists(self.stats_path()):
+            self.prepare(download=download)
+        self._load_meta()
+
+        if self.do_iid:
+            # IID: a fixed permutation reassigns data to clients
+            # uniformly (reference fed_dataset.py:28-29,71-75)
+            rng = np.random.RandomState(seed)
+            self.iid_shuffle = rng.permutation(len(self))
+
+        # precompute flat-index offsets of the natural partition
+        self._nat_cumsum = np.concatenate(
+            [[0], np.cumsum(self.images_per_client)])
+
+    # ---- metadata -------------------------------------------------------
+    def stats_path(self) -> str:
+        return os.path.join(self.dataset_dir, self.dataset_name,
+                            "stats.json")
+
+    def write_stats(self, images_per_client: Sequence[int],
+                    num_val_images: int):
+        os.makedirs(os.path.dirname(self.stats_path()), exist_ok=True)
+        with open(self.stats_path(), "w") as f:
+            json.dump({"images_per_client": [int(x) for x in images_per_client],
+                       "num_val_images": int(num_val_images)}, f)
+
+    def _load_meta(self):
+        with open(self.stats_path()) as f:
+            stats = json.load(f)
+        self.images_per_client = np.array(stats["images_per_client"])
+        self.num_val_images = int(stats["num_val_images"])
+
+    # ---- partition geometry --------------------------------------------
+    @property
+    def num_clients(self) -> int:
+        return (self._num_clients if self._num_clients is not None
+                else len(self.images_per_client))
+
+    @property
+    def data_per_client(self) -> np.ndarray:
+        """Per-client example counts after resharding the natural
+        partition over `num_clients` (reference fed_dataset.py:31-48:
+        each natural unit — a class, writer, persona — is split across
+        num_clients/num_units clients)."""
+        if self.do_iid:
+            n = len(self)
+            per = np.full(self.num_clients, n // self.num_clients, dtype=int)
+            per[self.num_clients - (n % self.num_clients):] += 1 \
+                if n % self.num_clients else 0
+            return per
+        out = []
+        n_units = len(self.images_per_client)
+        per_unit = self._num_clients // n_units if self._num_clients else 1
+        for n_images in self.images_per_client:
+            counts = [n_images // per_unit] * per_unit
+            counts[-1] += n_images % per_unit
+            out.extend(counts)
+        return np.array(out)
+
+    def __len__(self) -> int:
+        if self.train:
+            return int(np.sum(self.images_per_client))
+        return self.num_val_images
+
+    # ---- fetch ----------------------------------------------------------
+    def client_flat_indices(self, client_id: int,
+                            idx_within: np.ndarray) -> np.ndarray:
+        """Map (client, local index) to flat dataset indices."""
+        dpc_cumsum = np.concatenate([[0], np.cumsum(self.data_per_client)])
+        flat = dpc_cumsum[client_id] + idx_within
+        if self.do_iid:
+            flat = self.iid_shuffle[flat]
+        return flat
+
+    def get_client_batch(self, client_id: int,
+                         idx_within: np.ndarray) -> Tuple[np.ndarray, ...]:
+        """Fetch one client's (transformed) examples by local index."""
+        flat = self.client_flat_indices(client_id, np.asarray(idx_within))
+        # flat index -> (natural client, index within natural client)
+        nat = np.searchsorted(self._nat_cumsum, flat, side="right") - 1
+        within = flat - self._nat_cumsum[nat]
+        batch = self._gather_train(nat, within)
+        if self.transform is not None:
+            batch = self.transform(*batch)
+        return batch
+
+    def get_val_batch(self, idxs: np.ndarray) -> Tuple[np.ndarray, ...]:
+        batch = self._get_val_batch(np.asarray(idxs))
+        if self.transform is not None:
+            batch = self.transform(*batch)
+        return batch
+
+    def _gather_train(self, nat_clients: np.ndarray,
+                      idx_within: np.ndarray) -> Tuple[np.ndarray, ...]:
+        """Default gather: group by natural client and concatenate."""
+        parts = []
+        order = np.argsort(nat_clients, kind="stable")
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        sorted_nat = nat_clients[order]
+        sorted_within = idx_within[order]
+        outs = None
+        for cid in np.unique(sorted_nat):
+            sel = sorted_nat == cid
+            got = self._get_train_batch(int(cid), sorted_within[sel])
+            if outs is None:
+                outs = [[] for _ in got]
+            for o, g in zip(outs, got):
+                o.append(g)
+        stacked = [np.concatenate(o, axis=0) for o in outs]
+        return tuple(s[inv] for s in stacked)
+
+    # ---- subclass API ---------------------------------------------------
+    def prepare(self, download: bool = False):
+        raise NotImplementedError
+
+    def _get_train_batch(self, nat_client_id: int, idxs: np.ndarray):
+        raise NotImplementedError
+
+    def _get_val_batch(self, idxs: np.ndarray):
+        raise NotImplementedError

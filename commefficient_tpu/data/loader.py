@@ -1,0 +1,75 @@
+"""Round-batch assembly: dataset + sampler -> device-ready arrays.
+
+The glue the reference spreads across torch DataLoader construction
+(reference: CommEfficient/cv_train.py:254-287) and the per-round
+client grouping inside the aggregator (fed_aggregator.py:218-237).
+Here grouping is free — the sampler already emits [num_workers, B]
+per-client blocks — and batches go to the device as single contiguous
+NHWC arrays.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.data.sampler import FedSampler, RoundIndices, ValSampler
+
+
+class FedLoader:
+    """Iterates training rounds: for each RoundIndices, fetches and
+    transforms every participating client's examples and stacks them
+    into (client_ids [W], data pytree [W, B, ...], mask [W, B])."""
+
+    def __init__(self, dataset: FedDataset, num_workers: int,
+                 local_batch_size: int, seed: int = 0):
+        self.dataset = dataset
+        self.sampler = FedSampler(dataset.data_per_client, num_workers,
+                                  local_batch_size, seed=seed)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.sampler.steps_per_epoch()
+
+    def epoch(self) -> Iterator[Tuple[np.ndarray, Tuple[np.ndarray, ...],
+                                      np.ndarray]]:
+        B = self.sampler.round_batch_size
+        for r in self.sampler.epoch():
+            per_client = []
+            for w, cid in enumerate(r.client_ids):
+                n_valid = int(r.mask[w].sum())
+                got = self.dataset.get_client_batch(
+                    int(cid), r.idx_within[w, :n_valid])
+                per_client.append((n_valid, got))
+            # allocate static [W, B, ...] buffers from the first fetch
+            protos = per_client[0][1]
+            data = tuple(
+                np.zeros((len(r.client_ids), B) + p.shape[1:], p.dtype)
+                for p in protos)
+            for w, (n_valid, got) in enumerate(per_client):
+                for buf, g in zip(data, got):
+                    buf[w, :n_valid] = g
+            yield r.client_ids, data, r.mask
+
+
+class FedValLoader:
+    """Validation batches as [num_shards, valid_batch_size, ...] blocks
+    (reference _call_val sharding, fed_aggregator.py:337-348)."""
+
+    def __init__(self, dataset: FedDataset, valid_batch_size: int,
+                 num_shards: int):
+        self.dataset = dataset
+        self.sampler = ValSampler(dataset.num_val_images, valid_batch_size,
+                                  num_shards)
+        self.vb = valid_batch_size
+        self.num_shards = num_shards
+
+    def batches(self):
+        for r in self.sampler.batches():
+            flat_idx = r.idx_within.reshape(-1)
+            got = self.dataset.get_val_batch(flat_idx)
+            data = tuple(
+                g.reshape((self.num_shards, self.vb) + g.shape[1:])
+                for g in got)
+            yield data, r.mask

@@ -1,0 +1,107 @@
+"""Client sampling: which clients participate each round, with which
+data — emitting *static-shape* padded batches.
+
+Capability parity with the reference's FedSampler (reference:
+CommEfficient/data_utils/fed_sampler.py:19-68): per epoch, permute data
+within each client, then repeatedly draw `num_workers` non-exhausted
+clients without replacement and take up to `local_batch_size` examples
+from each (the whole remaining client dataset when -1).
+
+TPU-first difference: the reference yields ragged index lists (variable
+`actual_batch_sizes`, fed_sampler.py:55-62) and lets torch build
+variable-size batches; XLA needs one compiled program, so every round
+here is [num_workers, B] indices + an f32 validity mask, B fixed for
+the whole run (SURVEY.md §7.3 hard part #2). Rounds with fewer than
+num_workers non-exhausted clients end the epoch — the reference
+*dispatches* such batches and then skips them in the driver
+(cv_train.py:205-219), which is equivalent up to RNG state.
+"""
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple, Optional
+
+import numpy as np
+
+
+class RoundIndices(NamedTuple):
+    client_ids: np.ndarray   # [num_workers] int32
+    idx_within: np.ndarray   # [num_workers, B] int32 local indices
+    mask: np.ndarray         # [num_workers, B] f32 validity
+
+
+class FedSampler:
+    def __init__(self, data_per_client: np.ndarray, num_workers: int,
+                 local_batch_size: int, seed: int = 0,
+                 shuffle_clients: bool = True):
+        self.data_per_client = np.asarray(data_per_client)
+        self.num_clients = len(self.data_per_client)
+        self.num_workers = num_workers
+        self.local_batch_size = local_batch_size
+        self.rng = np.random.RandomState(seed)
+        self.shuffle_clients = shuffle_clients
+        if num_workers > self.num_clients:
+            raise ValueError(
+                f"num_workers={num_workers} > num_clients={self.num_clients}")
+
+    @property
+    def round_batch_size(self) -> int:
+        """Static per-client batch dimension B."""
+        if self.local_batch_size == -1:
+            return int(self.data_per_client.max())
+        return self.local_batch_size
+
+    def steps_per_epoch(self) -> int:
+        """(reference utils.py:315-321)"""
+        if self.local_batch_size == -1:
+            return int(self.num_clients // self.num_workers)
+        total = int(self.data_per_client.sum())
+        return int(np.ceil(total / (self.local_batch_size * self.num_workers)))
+
+    def epoch(self) -> Iterator[RoundIndices]:
+        B = self.round_batch_size
+        dpc = self.data_per_client
+        # per-client permutation of local indices
+        perms = [self.rng.permutation(n) for n in dpc]
+        cursor = np.zeros(self.num_clients, dtype=int)
+
+        while True:
+            alive = np.where(cursor < dpc)[0]
+            if len(alive) < self.num_workers:
+                return
+            chosen = self.rng.choice(alive, self.num_workers, replace=False)
+            idx = np.zeros((self.num_workers, B), np.int32)
+            mask = np.zeros((self.num_workers, B), np.float32)
+            for w, cid in enumerate(chosen):
+                remaining = dpc[cid] - cursor[cid]
+                take = remaining if self.local_batch_size == -1 else min(
+                    remaining, self.local_batch_size)
+                sel = perms[cid][cursor[cid]:cursor[cid] + take]
+                idx[w, :take] = sel
+                mask[w, :take] = 1.0
+                cursor[cid] += take
+            yield RoundIndices(chosen.astype(np.int32), idx, mask)
+
+
+class ValSampler:
+    """Shards the validation set into fixed [S, valid_batch_size]
+    blocks, padding the tail with masked examples (the val path of
+    reference fed_aggregator.py:337-348 splits by valid_batch_size)."""
+
+    def __init__(self, num_examples: int, valid_batch_size: int,
+                 num_shards: int):
+        self.n = num_examples
+        self.vb = valid_batch_size
+        self.num_shards = num_shards
+
+    def batches(self) -> Iterator[RoundIndices]:
+        per_super = self.vb * self.num_shards
+        for start in range(0, self.n, per_super):
+            idxs = np.arange(start, min(start + per_super, self.n))
+            pad = per_super - len(idxs)
+            mask = np.concatenate(
+                [np.ones(len(idxs), np.float32), np.zeros(pad, np.float32)])
+            idxs = np.concatenate([idxs, np.zeros(pad, np.int64)])
+            yield RoundIndices(
+                np.full(self.num_shards, -1, np.int32),
+                idxs.reshape(self.num_shards, self.vb).astype(np.int32),
+                mask.reshape(self.num_shards, self.vb))

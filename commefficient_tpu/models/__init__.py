@@ -1,0 +1,56 @@
+"""Model registry.
+
+Name-compatible with the reference's string-resolved model flags
+(reference: CommEfficient/utils.py:114-118 builds --model choices from
+dir(models); cv_train.py:363 resolves by getattr). The reference only
+exports ResNet9 (models/__init__.py:1-7) but ships the whole family;
+here everything ships working.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Callable, Dict
+
+from commefficient_tpu.models.resnet9 import ResNet9, StatelessBatchNorm  # noqa: F401
+from commefficient_tpu.models.fixup_resnet import (  # noqa: F401
+    FixupResNet18, FixupResNet9, ResNet18,
+)
+from commefficient_tpu.models import resnets
+from commefficient_tpu.models.resnets import ResNet  # noqa: F401
+
+_REGISTRY: Dict[str, Callable] = {
+    "ResNet9": ResNet9,
+    "FixupResNet9": FixupResNet9,
+    "ResNet18": ResNet18,
+    "FixupResNet18": FixupResNet18,
+    "ResNet34": resnets.resnet34,
+    "ResNet50": resnets.resnet50,
+    "ResNet101": resnets.resnet101,
+    "ResNet152": resnets.resnet152,
+    "WideResNet50_2": resnets.wide_resnet50_2,
+    "WideResNet101_2": resnets.wide_resnet101_2,
+    "ResNet101LN": resnets.resnet101ln,
+    "FixupResNet50": resnets.fixup_resnet50,
+}
+
+
+def model_names():
+    return sorted(_REGISTRY)
+
+
+def build_model(name: str, **config):
+    """Instantiate a model by flag name, dropping config keys the
+    target model doesn't take (the reference passes one shared
+    model_config dict to every model class, cv_train.py:329-364)."""
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; known: {model_names()}")
+
+    if dataclasses.is_dataclass(cls):
+        fields = {f.name for f in dataclasses.fields(cls)}
+    else:
+        fields = set(inspect.signature(cls).parameters)
+    kept = {k: v for k, v in config.items() if k in fields}
+    return cls(**kept)

@@ -1,0 +1,212 @@
+"""Fixup (normalization-free) and PreAct residual nets in Flax, NHWC.
+
+Capability parity with the reference's norm-free model family
+(reference: CommEfficient/models/fixup_resnet18.py — `FixupResNet18`
+at :66-135, `ResNet18` (PreAct) at :138-216, scalar `Mul`/`Add`
+modules at :8-22) and its Fixup-ResNet9 variant (reference
+models/fixup_resnet9.py imports an external non-vendored `fixup`
+package; rebuilt here from the Fixup recipe directly).
+
+Fixup exists precisely because BatchNorm is ill-posed in federated
+simulation (SURVEY.md §7.3 #6): tiny non-IID per-client batches make
+batch statistics garbage, so these nets replace normalization with
+careful init + scalar biases/scales:
+  * conv1 of each block: normal(0, sqrt(2/(c_out*k*k)) * L^-0.5)
+  * conv2 of each block: zeros; classifier: zeros
+  * scalar Add before/after each conv, scalar Mul on the branch
+(reference init loop at models/fixup_resnet18.py:85-106).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.models.resnet9 import (
+    DEFAULT_CHANNELS, StatelessBatchNorm,
+)
+
+
+def _fixup_branch_init(num_layers: int):
+    def init(key, shape, dtype=jnp.float32):
+        # NHWC kernel shape (kh, kw, c_in, c_out); the reference's
+        # formula uses c_out * kh * kw (fixup_resnet18.py:88-91)
+        kh, kw, _, c_out = shape
+        std = np.sqrt(2.0 / (c_out * kh * kw)) * num_layers ** (-0.5)
+        return jax.random.normal(key, shape, dtype) * std
+    return init
+
+
+def _out_fan_init():
+    def init(key, shape, dtype=jnp.float32):
+        kh, kw, _, c_out = shape
+        std = np.sqrt(2.0 / (c_out * kh * kw))
+        return jax.random.normal(key, shape, dtype) * std
+    return init
+
+
+class ScalarAdd(nn.Module):
+    """Learnable scalar bias (reference Add, fixup_resnet18.py:16-22)."""
+    @nn.compact
+    def __call__(self, x):
+        return x + self.param("bias", nn.initializers.zeros, (1,))
+
+
+class ScalarMul(nn.Module):
+    """Learnable scalar scale (reference Mul, fixup_resnet18.py:8-14)."""
+    @nn.compact
+    def __call__(self, x):
+        return x * self.param("scale", nn.initializers.ones, (1,))
+
+
+class FixupBlock(nn.Module):
+    """(reference FixupBlock, fixup_resnet18.py:24-63)"""
+    features: int
+    stride: int = 1
+    num_layers: int = 8  # total blocks in net, for the L^-0.5 factor
+
+    @nn.compact
+    def __call__(self, x):
+        needs_proj = self.stride != 1 or x.shape[-1] != self.features
+        shortcut = x
+        if needs_proj:
+            shortcut = nn.Conv(self.features, (1, 1), strides=self.stride,
+                               use_bias=False, kernel_init=_out_fan_init(),
+                               name="shortcut")(x)
+        y = ScalarAdd(name="add1a")(x)
+        y = nn.Conv(self.features, (3, 3), strides=self.stride, padding=1,
+                    use_bias=False,
+                    kernel_init=_fixup_branch_init(self.num_layers),
+                    name="conv1")(y)
+        y = nn.relu(ScalarAdd(name="add1b")(y))
+        y = ScalarAdd(name="add2a")(y)
+        y = nn.Conv(self.features, (3, 3), strides=1, padding=1,
+                    use_bias=False, kernel_init=nn.initializers.zeros,
+                    name="conv2")(y)
+        y = ScalarAdd(name="add2b")(ScalarMul(name="mul")(y))
+        return nn.relu(y + shortcut)
+
+
+class PreActBlock(nn.Module):
+    """conv->BN->relu twice + shortcut (reference PreActBlock,
+    fixup_resnet18.py:138-165; despite the name, the as-shipped
+    reference applies norm *after* each conv — we keep its actual
+    dataflow)."""
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.features, (3, 3), strides=self.stride, padding=1,
+                    use_bias=False, name="conv1")(x)
+        y = nn.relu(StatelessBatchNorm(name="bn1")(y))
+        y = nn.Conv(self.features, (3, 3), strides=1, padding=1,
+                    use_bias=False, name="conv2")(y)
+        y = nn.relu(StatelessBatchNorm(name="bn2")(y))
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.features:
+            shortcut = nn.Conv(self.features, (1, 1), strides=self.stride,
+                               use_bias=False, name="shortcut")(x)
+        return y + shortcut
+
+
+def _dual_pool_head(x):
+    """Global avg-pool || max-pool concat (reference
+    fixup_resnet18.py:125-131)."""
+    x_avg = x.mean(axis=(1, 2))
+    x_max = x.max(axis=(1, 2))
+    return jnp.concatenate([x_avg, x_max], axis=-1)
+
+
+class FixupResNet18(nn.Module):
+    """(reference FixupResNet18, fixup_resnet18.py:66-135)"""
+    num_classes: int = 10
+    num_blocks: Tuple[int, ...] = (2, 2, 2, 2)
+    widths: Tuple[int, ...] = (64, 128, 256, 256)
+    initial_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        L = sum(self.num_blocks)
+        x = nn.Conv(64, (3, 3), strides=1, padding=1, use_bias=False,
+                    kernel_init=_out_fan_init(), name="prep")(x)
+        x = nn.relu(x)
+        for stage, (w, n) in enumerate(zip(self.widths, self.num_blocks)):
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = FixupBlock(w, stride, num_layers=L)(x)
+        x = _dual_pool_head(x)
+        x = nn.Dense(self.num_classes, kernel_init=nn.initializers.zeros,
+                     bias_init=nn.initializers.zeros, name="classifier")(x)
+        return x
+
+
+class ResNet18(nn.Module):
+    """PreAct-style ResNet18 with stateless BN (reference ResNet18,
+    fixup_resnet18.py:168-216)."""
+    num_classes: int = 10
+    num_blocks: Tuple[int, ...] = (2, 2, 2, 2)
+    widths: Tuple[int, ...] = (64, 128, 256, 256)
+    initial_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(64, (3, 3), strides=1, padding=1, use_bias=False,
+                    name="prep")(x)
+        x = nn.relu(x)
+        for stage, (w, n) in enumerate(zip(self.widths, self.num_blocks)):
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = PreActBlock(w, stride)(x)
+        x = _dual_pool_head(x)
+        x = nn.Dense(self.num_classes, name="classifier")(x)
+        return x
+
+
+class FixupResNet9(nn.Module):
+    """ResNet9 topology with Fixup-style scalar bias/scale and no
+    normalization (the capability of reference models/fixup_resnet9.py,
+    whose implementation lives in an external non-vendored package)."""
+    num_classes: int = 10
+    weight: float = 0.125
+    initial_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        ch = DEFAULT_CHANNELS
+        L = 2  # residual blocks
+
+        def conv_block(x, feats, pool=False):
+            x = ScalarAdd()(x)
+            x = nn.Conv(feats, (3, 3), strides=1, padding=1, use_bias=False,
+                        kernel_init=_out_fan_init())(x)
+            x = nn.relu(ScalarAdd()(x))
+            if pool:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            return x
+
+        def residual(x, feats):
+            y = ScalarAdd()(x)
+            y = nn.Conv(feats, (3, 3), padding=1, use_bias=False,
+                        kernel_init=_fixup_branch_init(L))(y)
+            y = nn.relu(ScalarAdd()(y))
+            y = ScalarAdd()(y)
+            y = nn.Conv(feats, (3, 3), padding=1, use_bias=False,
+                        kernel_init=nn.initializers.zeros)(y)
+            y = ScalarAdd()(ScalarMul()(y))
+            return x + nn.relu(y)
+
+        x = conv_block(x, ch["prep"])
+        x = conv_block(x, ch["layer1"], pool=True)
+        x = residual(x, ch["layer1"])
+        x = conv_block(x, ch["layer2"], pool=True)
+        x = conv_block(x, ch["layer3"], pool=True)
+        x = residual(x, ch["layer3"])
+        x = nn.max_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, use_bias=False,
+                     kernel_init=nn.initializers.zeros, name="head")(x)
+        return x * self.weight

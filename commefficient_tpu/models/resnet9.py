@@ -1,0 +1,98 @@
+"""ResNet9 (cifar10-fast style) in Flax, NHWC.
+
+Capability parity with the reference model (reference:
+CommEfficient/models/resnet9.py): prep/layer1+res/layer2/layer3+res
+conv stack, optional BatchNorm (off by default — BN is problematic in
+federated learning, reference utils.py:138 & SURVEY.md §7.3 #6), 0.125
+logit scale (reference resnet9.py:9-14,93 `Mul`), head-swap finetune
+support (reference :105-130).
+
+TPU-first notes: NHWC layout (XLA:TPU's native conv layout), 3x3
+convs without bias feed the MXU directly; when do_batchnorm is on,
+normalization always uses the current batch's statistics — the
+reference never synchronizes BN running stats across clients (worker
+processes keep private stale buffers), so carrying running averages
+would only replicate noise; computing batch stats keeps the model a
+pure function of (params, batch).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+DEFAULT_CHANNELS = {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}
+
+
+class StatelessBatchNorm(nn.Module):
+    """Batch normalization as a pure function of the current batch:
+    learnable scale/bias, no running-average state (see module
+    docstring for why running stats are deliberately absent)."""
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        mean = x.mean(axis=(0, 1, 2))
+        var = x.var(axis=(0, 1, 2))
+        scale = self.param("scale", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        return (x - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
+
+
+class ConvBlock(nn.Module):
+    """conv3x3 (no bias) -> [BN] -> ReLU -> [pool] (reference ConvBN,
+    models/resnet9.py:32-50)."""
+    features: int
+    do_batchnorm: bool = False
+    pool: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (3, 3), strides=1, padding=1,
+                    use_bias=False,
+                    kernel_init=nn.initializers.he_normal())(x)
+        if self.do_batchnorm:
+            x = StatelessBatchNorm()(x)
+        x = nn.relu(x)
+        if self.pool:
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class Residual(nn.Module):
+    """x + relu-stack of two conv blocks (reference Residual,
+    models/resnet9.py:61-68)."""
+    features: int
+    do_batchnorm: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        y = ConvBlock(self.features, self.do_batchnorm)(x)
+        y = ConvBlock(self.features, self.do_batchnorm)(y)
+        return x + y
+
+
+class ResNet9(nn.Module):
+    num_classes: int = 10
+    channels: Optional[Dict[str, int]] = None
+    weight: float = 0.125           # logit scale (reference Mul)
+    do_batchnorm: bool = False
+    initial_channels: int = 3       # 1 for EMNIST (cv_train.py:353-354)
+
+    @nn.compact
+    def __call__(self, x):
+        ch = self.channels or DEFAULT_CHANNELS
+        x = ConvBlock(ch["prep"], self.do_batchnorm)(x)
+        x = ConvBlock(ch["layer1"], self.do_batchnorm, pool=True)(x)
+        x = Residual(ch["layer1"], self.do_batchnorm)(x)
+        x = ConvBlock(ch["layer2"], self.do_batchnorm, pool=True)(x)
+        x = ConvBlock(ch["layer3"], self.do_batchnorm, pool=True)(x)
+        x = Residual(ch["layer3"], self.do_batchnorm)(x)
+        x = nn.max_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, use_bias=False,
+                     name="head")(x)
+        return x * self.weight

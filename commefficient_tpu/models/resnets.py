@@ -1,0 +1,196 @@
+"""Torchvision-style ResNet family in Flax, NHWC, with pluggable
+normalization.
+
+Capability parity with the reference's modified-torchvision copy
+(reference: CommEfficient/models/resnets.py — ResNet18..Wide101 with a
+`norm_layer` hook extended to support LayerNorm by threading the
+spatial size through blocks, :79-98,191+; and
+models/resnet101ln.py:8-13 `ResNet101LN`), plus a Fixup bottleneck
+variant covering the capability of models/fixup_resnet.py
+(FixupResNet50, whose implementation the reference imports from an
+external, non-vendored package).
+
+Norm options: "batch" (stateless batch statistics — see
+resnet9.StatelessBatchNorm), "layer" (normalizes over (H, W, C) like
+torch nn.LayerNorm([C, H, W]); no spatial-size threading needed — in
+Flax the shape is known at trace time), "group", "none".
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from commefficient_tpu.models.resnet9 import StatelessBatchNorm
+from commefficient_tpu.models.fixup_resnet import (
+    ScalarAdd, ScalarMul, _fixup_branch_init, _out_fan_init,
+)
+
+
+def _norm(kind: str, name: str):
+    if kind == "batch":
+        return StatelessBatchNorm(name=name)
+    if kind == "layer":
+        return nn.LayerNorm(reduction_axes=(-3, -2, -1),
+                            feature_axes=(-3, -2, -1), name=name)
+    if kind == "group":
+        return nn.GroupNorm(num_groups=32, name=name)
+    if kind == "none":
+        return lambda x: x
+    raise ValueError(f"unknown norm {kind}")
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.features, (3, 3), strides=self.stride, padding=1,
+                    use_bias=False, name="conv1")(x)
+        y = nn.relu(_norm(self.norm, "bn1")(y))
+        y = nn.Conv(self.features, (3, 3), strides=1, padding=1,
+                    use_bias=False, name="conv2")(y)
+        y = _norm(self.norm, "bn2")(y)
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.features:
+            shortcut = nn.Conv(self.features, (1, 1), strides=self.stride,
+                               use_bias=False, name="downsample")(x)
+            shortcut = _norm(self.norm, "bn_down")(shortcut)
+        return nn.relu(y + shortcut)
+
+
+class Bottleneck(nn.Module):
+    features: int      # bottleneck width; output is 4x
+    stride: int = 1
+    norm: str = "batch"
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        out_ch = self.features * self.expansion
+        y = nn.Conv(self.features, (1, 1), use_bias=False, name="conv1")(x)
+        y = nn.relu(_norm(self.norm, "bn1")(y))
+        y = nn.Conv(self.features, (3, 3), strides=self.stride, padding=1,
+                    use_bias=False, name="conv2")(y)
+        y = nn.relu(_norm(self.norm, "bn2")(y))
+        y = nn.Conv(out_ch, (1, 1), use_bias=False, name="conv3")(y)
+        y = _norm(self.norm, "bn3")(y)
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            shortcut = nn.Conv(out_ch, (1, 1), strides=self.stride,
+                               use_bias=False, name="downsample")(x)
+            shortcut = _norm(self.norm, "bn_down")(shortcut)
+        return nn.relu(y + shortcut)
+
+
+class FixupBottleneck(nn.Module):
+    """Fixup-initialized bottleneck (norm-free ResNet50-class nets)."""
+    features: int
+    stride: int = 1
+    num_layers: int = 16
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        out_ch = self.features * self.expansion
+        y = ScalarAdd(name="add1a")(x)
+        y = nn.Conv(self.features, (1, 1), use_bias=False,
+                    kernel_init=_fixup_branch_init(self.num_layers),
+                    name="conv1")(y)
+        y = nn.relu(ScalarAdd(name="add1b")(y))
+        y = ScalarAdd(name="add2a")(y)
+        y = nn.Conv(self.features, (3, 3), strides=self.stride, padding=1,
+                    use_bias=False,
+                    kernel_init=_fixup_branch_init(self.num_layers),
+                    name="conv2")(y)
+        y = nn.relu(ScalarAdd(name="add2b")(y))
+        y = ScalarAdd(name="add3a")(y)
+        y = nn.Conv(out_ch, (1, 1), use_bias=False,
+                    kernel_init=nn.initializers.zeros, name="conv3")(y)
+        y = ScalarAdd(name="add3b")(ScalarMul(name="mul")(y))
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            shortcut = nn.Conv(out_ch, (1, 1), strides=self.stride,
+                               use_bias=False, kernel_init=_out_fan_init(),
+                               name="downsample")(x)
+        return nn.relu(y + shortcut)
+
+
+class ResNet(nn.Module):
+    """Generic ImageNet-stem ResNet (reference models/resnets.py ResNet)."""
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    block: str = "bottleneck"   # "basic" | "bottleneck" | "fixup_bottleneck"
+    norm: str = "batch"
+    width: int = 64             # base width (128 for wide variants)
+    initial_channels: int = 3
+    small_input: bool = False   # CIFAR-style 3x3 stem, no maxpool
+
+    @nn.compact
+    def __call__(self, x):
+        L = sum(self.stage_sizes)
+        if self.small_input:
+            x = nn.Conv(64, (3, 3), strides=1, padding=1, use_bias=False,
+                        name="conv1")(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                        name="conv1")(x)
+        if self.block != "fixup_bottleneck":
+            x = _norm(self.norm, "bn1")(x)
+        x = nn.relu(x)
+        if not self.small_input:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        for stage, n in enumerate(self.stage_sizes):
+            feats = self.width * (2 ** stage)
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                if self.block == "basic":
+                    x = BasicBlock(feats, stride, self.norm)(x)
+                elif self.block == "bottleneck":
+                    x = Bottleneck(feats, stride, self.norm)(x)
+                else:
+                    x = FixupBottleneck(feats, stride, num_layers=L)(x)
+
+        x = x.mean(axis=(1, 2))
+        head_init = (nn.initializers.zeros
+                     if self.block == "fixup_bottleneck"
+                     else nn.initializers.lecun_normal())
+        x = nn.Dense(self.num_classes, kernel_init=head_init, name="fc")(x)
+        return x
+
+
+# ---- named constructors (reference models/resnets.py:250+ factory fns) ----
+
+def resnet18(**kw):
+    return ResNet(stage_sizes=(2, 2, 2, 2), block="basic", **kw)
+
+def resnet34(**kw):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block="basic", **kw)
+
+def resnet50(**kw):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block="bottleneck", **kw)
+
+def resnet101(**kw):
+    return ResNet(stage_sizes=(3, 4, 23, 3), block="bottleneck", **kw)
+
+def resnet152(**kw):
+    return ResNet(stage_sizes=(3, 8, 36, 3), block="bottleneck", **kw)
+
+def wide_resnet50_2(**kw):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block="bottleneck", width=128, **kw)
+
+def wide_resnet101_2(**kw):
+    return ResNet(stage_sizes=(3, 4, 23, 3), block="bottleneck", width=128, **kw)
+
+def resnet101ln(**kw):
+    """(reference models/resnet101ln.py:8-13)"""
+    kw.setdefault("norm", "layer")
+    return resnet101(**kw)
+
+def fixup_resnet50(**kw):
+    """(capability of reference models/fixup_resnet.py FixupResNet50)"""
+    return ResNet(stage_sizes=(3, 4, 6, 3), block="fixup_bottleneck", **kw)

@@ -1,0 +1,127 @@
+"""Data layer tests: partitioning, sampling invariants, static-shape
+batch assembly (reference semantics: data_utils/fed_dataset.py,
+fed_sampler.py, fed_cifar.py)."""
+import numpy as np
+import pytest
+
+from commefficient_tpu.data import (
+    FedCIFAR10, FedCIFAR100, FedLoader, FedSampler, FedValLoader, ValSampler,
+)
+from commefficient_tpu.data.transforms import cifar10_transforms
+
+
+@pytest.fixture(scope="module")
+def cifar(tmp_path_factory):
+    root = tmp_path_factory.mktemp("data")
+    return FedCIFAR10(str(root), synthetic_examples=(500, 100))
+
+
+def test_natural_partition_one_class_per_client(cifar):
+    assert len(cifar.images_per_client) == 10
+    assert cifar.images_per_client.sum() == 500
+    assert cifar.num_val_images == 100
+    # every example of natural client c has label c
+    imgs, labels = cifar.get_client_batch(3, np.arange(5))
+    assert imgs.shape == (5, 32, 32, 3)
+    assert np.all(labels == 3)
+
+
+def test_resharding_num_clients(tmp_path):
+    ds = FedCIFAR10(str(tmp_path), num_clients=20,
+                    synthetic_examples=(500, 100))
+    dpc = ds.data_per_client
+    assert len(dpc) == 20
+    assert dpc.sum() == 500
+    # each class split over 2 clients; labels consistent
+    _, labels_a = ds.get_client_batch(6, np.arange(3))
+    _, labels_b = ds.get_client_batch(7, np.arange(3))
+    assert np.all(labels_a == 3) and np.all(labels_b == 3)
+
+
+def test_iid_shuffle_mixes_labels(tmp_path):
+    ds = FedCIFAR10(str(tmp_path), do_iid=True, num_clients=10,
+                    synthetic_examples=(500, 100))
+    _, labels = ds.get_client_batch(0, np.arange(40))
+    assert len(np.unique(labels)) > 1  # not a single class
+
+
+def test_sampler_covers_epoch_exactly_once():
+    dpc = np.array([10, 12, 8, 30, 5, 7, 20, 9])
+    s = FedSampler(dpc, num_workers=4, local_batch_size=4, seed=1)
+    seen = [set() for _ in dpc]
+    for r in s.epoch():
+        assert len(np.unique(r.client_ids)) == 4
+        for w, cid in enumerate(r.client_ids):
+            n = int(r.mask[w].sum())
+            assert n > 0
+            idxs = r.idx_within[w, :n]
+            assert not (seen[cid] & set(idxs.tolist()))
+            seen[cid] |= set(idxs.tolist())
+            # padding region is zero
+            assert np.all(r.idx_within[w, n:] == 0)
+    # epoch ends exactly when fewer than num_workers clients still
+    # have data; everything visited at most once (checked above) and
+    # the leftover is confined to < num_workers clients
+    leftover_clients = sum(
+        1 for c, n in enumerate(dpc) if len(seen[c]) < n)
+    assert leftover_clients < 4
+
+
+def test_sampler_fedavg_whole_client():
+    dpc = np.array([10, 12, 8, 30])
+    s = FedSampler(dpc, num_workers=2, local_batch_size=-1, seed=0)
+    assert s.round_batch_size == 30
+    rounds = list(s.epoch())
+    assert len(rounds) == 2  # 4 clients / 2 workers
+    for r in rounds:
+        for w, cid in enumerate(r.client_ids):
+            assert int(r.mask[w].sum()) == dpc[cid]
+
+
+def test_steps_per_epoch():
+    dpc = np.array([10, 10, 10, 10])
+    assert FedSampler(dpc, 2, 5).steps_per_epoch() == 4
+    assert FedSampler(dpc, 2, -1).steps_per_epoch() == 2
+
+
+def test_loader_static_shapes(cifar):
+    train_tf, _ = cifar10_transforms()
+    cifar.transform = train_tf
+    loader = FedLoader(cifar, num_workers=4, local_batch_size=8)
+    ids, data, mask = next(loader.epoch())
+    imgs, labels = data
+    assert ids.shape == (4,)
+    assert imgs.shape == (4, 8, 32, 32, 3)
+    assert imgs.dtype == np.float32
+    assert labels.shape == (4, 8)
+    assert mask.shape == (4, 8)
+    # labels match client class where valid (num_clients=10 natural)
+    for w in range(4):
+        n = int(mask[w].sum())
+        assert np.all(labels[w, :n] == ids[w])
+    cifar.transform = None
+
+
+def test_val_loader_pads_tail(cifar):
+    loader = FedValLoader(cifar, valid_batch_size=8, num_shards=4)
+    batches = list(loader.batches())
+    # 100 examples / 32 per super-batch -> 4 batches, last padded
+    assert len(batches) == 4
+    data, mask = batches[-1]
+    assert data[0].shape == (4, 8, 32, 32, 3)
+    assert mask.sum() == 100 - 3 * 32
+
+
+def test_cifar100(tmp_path):
+    ds = FedCIFAR100(str(tmp_path), synthetic_examples=(1000, 100))
+    assert len(ds.images_per_client) == 100
+
+
+def test_transform_determinism_and_range(cifar):
+    train_tf, test_tf = cifar10_transforms(seed=0)
+    imgs, labels = cifar.get_client_batch(0, np.arange(4))
+    out, lab = test_tf(imgs, labels)
+    assert out.dtype == np.float32
+    assert abs(float(out.mean())) < 3.0
+    out2, _ = train_tf(imgs, labels)
+    assert out2.shape == imgs.shape

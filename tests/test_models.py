@@ -1,0 +1,117 @@
+"""Model family tests: shapes, parameter-count parity with the
+reference architectures, init properties."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu import models
+from commefficient_tpu.ops.flat import flatten_params
+
+
+def init_and_run(model, shape=(2, 32, 32, 3)):
+    x = jnp.ones(shape)
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    return params, out
+
+
+def n_params(params):
+    vec, _ = flatten_params(params)
+    return vec.shape[0]
+
+
+def test_resnet9_shape_and_param_count():
+    model = models.build_model("ResNet9", num_classes=10)
+    params, out = init_and_run(model)
+    assert out.shape == (2, 10)
+    # cifar10-fast ResNet9, no BN, no biases: 6,568,640 params
+    # (conv kernels + 512x10 head; matches reference models/resnet9.py)
+    assert n_params(params) == 6_568_640
+
+
+def test_resnet9_batchnorm_adds_scale_bias():
+    model = models.build_model("ResNet9", num_classes=10, do_batchnorm=True)
+    params, out = init_and_run(model)
+    assert out.shape == (2, 10)
+    # 8 conv blocks gain (scale, bias) per channel:
+    # 64+128+128+128+256+512+512+512 = 2240 channels -> +4480
+    assert n_params(params) == 6_568_640 + 4480
+
+
+def test_resnet9_test_mode_tiny_channels():
+    # the reference --test smoke shrinks to 1 channel/layer
+    # (cv_train.py:329-336)
+    model = models.build_model(
+        "ResNet9", num_classes=10,
+        channels={"prep": 1, "layer1": 1, "layer2": 1, "layer3": 1})
+    params, out = init_and_run(model)
+    assert out.shape == (2, 10)
+    assert n_params(params) < 1000
+
+
+def test_resnet9_emnist_single_channel():
+    model = models.build_model("ResNet9", num_classes=62,
+                               initial_channels=1)
+    _, out = init_and_run(model, shape=(2, 28, 28, 1))
+    assert out.shape == (2, 62)
+
+
+def test_fixup_resnet18():
+    model = models.build_model("FixupResNet18", num_classes=10)
+    params, out = init_and_run(model)
+    assert out.shape == (2, 10)
+    # fixup: classifier zero-init -> logits exactly 0 at init
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_fixup_resnet9_zero_head_at_init():
+    model = models.build_model("FixupResNet9", num_classes=10)
+    _, out = init_and_run(model)
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_preact_resnet18():
+    model = models.build_model("ResNet18", num_classes=100)
+    _, out = init_and_run(model)
+    assert out.shape == (2, 100)
+
+
+def test_resnet50_imagenet_stem():
+    model = models.build_model("ResNet50", num_classes=1000)
+    params, out = init_and_run(model, shape=(1, 64, 64, 3))
+    assert out.shape == (1, 1000)
+    # torchvision resnet50 conv params ~23.5M (we use stateless BN:
+    # same scale/bias count as torch affine BN, no running buffers)
+    assert 23_000_000 < n_params(params) < 26_000_000
+
+
+def test_resnet101ln_layer_norm():
+    model = models.build_model("ResNet101LN", num_classes=10)
+    _, out = init_and_run(model, shape=(1, 32, 32, 3))
+    assert out.shape == (1, 10)
+
+
+def test_fixup_resnet50():
+    model = models.build_model("FixupResNet50", num_classes=10)
+    _, out = init_and_run(model, shape=(1, 32, 32, 3))
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_grads_flow_resnet9():
+    model = models.build_model("ResNet9", num_classes=10)
+    x = jnp.ones((2, 32, 32, 3))
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    def loss(p):
+        return model.apply(p, x).sum()
+
+    g = jax.grad(loss)(params)
+    gvec, _ = flatten_params(g)
+    assert float(jnp.abs(gvec).sum()) > 0
+    assert np.all(np.isfinite(np.asarray(gvec)))
+
+
+def test_build_model_unknown():
+    with pytest.raises(ValueError):
+        models.build_model("NoSuchNet")
