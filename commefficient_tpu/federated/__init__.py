@@ -1,0 +1,11 @@
+from commefficient_tpu.federated.round import (  # noqa: F401
+    RoundBatch, ServerState, ClientState, RoundMetrics,
+    init_server_state, init_client_state, make_round_fns,
+)
+from commefficient_tpu.federated.server import (  # noqa: F401
+    ServerUpdate, get_server_update, args2sketch,
+)
+from commefficient_tpu.federated.api import FedModel, FedOptimizer  # noqa: F401
+from commefficient_tpu.federated.accounting import (  # noqa: F401
+    CommAccountant, pack_change_bits,
+)

@@ -1,0 +1,118 @@
+"""Per-client communication accounting — the headline observability
+feature of the system (SURVEY.md §5; reference:
+CommEfficient/fed_aggregator.py:170-299).
+
+Semantics parity:
+  * upload bytes per participating client per round: 4 bytes x
+    mode-dependent float count (reference :291-299) — grad_size for
+    uncompressed/true_topk/fedavg, k for local_topk, r*c for sketch.
+  * download bytes per participating client: 4 bytes x number of
+    weights that changed since that client last participated
+    (reference :239-289), with the same cheap path (single
+    updated-since-init boolean when num_epochs <= 1 and whole-dataset
+    batches, :171-177) and bounded-staleness clamp (deque maxlen =
+    10/participation, :179-194 — under-counts clients stale for longer,
+    with probability < e^-10 as the reference's comment derives).
+
+TPU-first re-design of the expensive path: the reference keeps a deque
+of FULL weight vectors (maxlen x D floats — 28 MB x maxlen for
+ResNet9) and diffs against each participant's snapshot every round,
+O(maxlen x D) host work. The information actually needed is only
+*which coordinates changed each round*, and for the compressed modes
+that set is k-sparse. So the device packs the round's change mask into
+a D/32-word bitset (one small transfer), and the host keeps a deque of
+bitsets (875 KB each for 7M params): a client stale for s rounds costs
+one OR-reduction over s bitsets + popcount — exactly the
+"disagrees with the client's snapshot" count, modulo coordinates that
+changed and changed back to the identical float (which the reference
+counts as unchanged; measure-zero in practice).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config
+
+DEQUE_MAXLEN_MULT = 10  # (reference fed_aggregator.py:21)
+
+_POPCOUNT_TABLE = np.array([bin(i).count("1") for i in range(256)],
+                           dtype=np.uint32)
+
+
+def pack_change_bits(update: jax.Array) -> jax.Array:
+    """Device-side: pack (update != 0) into uint32 words. Runs under
+    jit; the host transfer is D/32 words instead of D floats."""
+    d = update.shape[0]
+    n_words = -(-d // 32)
+    bits = jnp.not_equal(update, 0.0)
+    bits = jnp.pad(bits, (0, n_words * 32 - d))
+    bits = bits.reshape(n_words, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return (bits * weights).sum(axis=1, dtype=jnp.uint32)
+
+
+def _popcount(words: np.ndarray) -> int:
+    return int(_POPCOUNT_TABLE[words.view(np.uint8)].sum())
+
+
+class CommAccountant:
+    def __init__(self, cfg: Config, num_clients: int):
+        self.cfg = cfg
+        self.num_clients = num_clients
+        self.n_words = -(-cfg.grad_size // 32)
+        # cheap path applies when every client re-downloads everything
+        # changed since init (reference fed_aggregator.py:171-177)
+        self.cheap = (cfg.num_epochs <= 1 and cfg.local_batch_size == -1)
+        if self.cheap:
+            self.updated_since_init = np.zeros(self.n_words, np.uint32)
+        else:
+            participation = cfg.num_workers / num_clients
+            maxlen = int(DEQUE_MAXLEN_MULT / participation)
+            self.changes: deque = deque([], maxlen=maxlen)
+            self.stale = np.zeros(num_clients, np.int64)
+
+    def record_round(self, participating: np.ndarray,
+                     prev_changed_words: Optional[np.ndarray]):
+        """Account one round. `prev_changed_words` is the packed change
+        bitset of the PREVIOUS round's weight update (None on the first
+        round — weights haven't changed since clients were initialized,
+        so round 1 downloads are free, matching reference :258-261).
+
+        Returns (download_bytes, upload_bytes), each [num_clients].
+        """
+        download = np.zeros(self.num_clients)
+        participating = np.asarray(participating)
+
+        if self.cheap:
+            if prev_changed_words is not None:
+                self.updated_since_init |= np.asarray(prev_changed_words)
+            download[participating] = 4.0 * _popcount(self.updated_since_init)
+        else:
+            if prev_changed_words is not None:
+                self.changes.append(np.asarray(prev_changed_words))
+            if len(self.changes):
+                stale = np.clip(self.stale[participating], 0,
+                                len(self.changes))
+                # unique staleness values share one OR-reduction prefix
+                order = np.argsort(stale)
+                acc = np.zeros(self.n_words, np.uint32)
+                depth = 0
+                counts = {0: 0}
+                for s in np.unique(stale):
+                    while depth < s:
+                        depth += 1
+                        acc |= self.changes[-depth]
+                    counts[int(s)] = _popcount(acc)
+                download[participating] = [
+                    4.0 * counts[int(s)] for s in stale]
+            self.stale[participating] = 0
+            self.stale += 1
+
+        upload = np.zeros(self.num_clients)
+        upload[participating] = 4.0 * self.cfg.upload_floats
+        return download, upload

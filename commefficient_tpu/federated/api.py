@@ -1,0 +1,179 @@
+"""Reference-shaped high-level API: FedModel + FedOptimizer.
+
+Drop-in call-pattern parity with the reference driver loop (reference:
+CommEfficient/cv_train.py:389-404, 193-229):
+
+    model = FedModel(module, compute_loss_train, cfg, compute_loss_val)
+    opt = FedOptimizer(model, cfg)
+    scheduler = LambdaLR(opt, lr_lambda=...)
+    ...
+    scheduler.step()
+    loss, acc, download, upload = model(batch)   # one federated round
+    opt.step()
+    ...
+    model.finalize()
+
+Under the hood there are no processes, queues, or shared memory
+(reference FedModel.__init__ spawns workers and a NCCL group,
+fed_aggregator.py:137-164): the entire round — client compute, psum,
+server decompression, weight update, client-state scatter — is ONE
+jitted program built by `federated.round.make_round_fns`, executed when
+`model(batch)` is called. The learning rate the scheduler set *before*
+the call is the one the fused round applies, which matches the
+reference's ordering (lr_scheduler.step() precedes model(batch),
+cv_train.py:198-229); `opt.step()` therefore only performs host-side
+bookkeeping and exists for call-pattern parity.
+
+The loss callback contract is preserved from the reference
+(SURVEY.md §3.5) modulo functional style: the reference takes
+compute_loss(model, batch, args) -> (loss, *metrics); here it is
+loss_fn(params_pytree, batch_tuple, mask) -> (loss, (metrics...)) —
+the mask is the price of static shapes.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated import round as fround
+from commefficient_tpu.federated.accounting import (
+    CommAccountant, pack_change_bits,
+)
+from commefficient_tpu.ops.flat import flatten_params
+from commefficient_tpu.parallel.mesh import make_client_mesh
+
+
+class FedModel:
+    def __init__(self, module, loss_train, cfg: Config,
+                 loss_val=None, params=None, mesh=None,
+                 init_batch=None, num_clients: Optional[int] = None,
+                 lr_scale_vec: Optional[np.ndarray] = None):
+        """module: a Flax module (init/apply) OR None if `params` and
+        loss callbacks close over the model themselves.
+        loss_*: loss_fn(params, batch_tuple, mask) -> (loss, metrics).
+        init_batch: example batch tuple for module.init.
+        """
+        self.module = module
+        self.training = True
+        if params is None:
+            if module is None or init_batch is None:
+                raise ValueError("need either params or module+init_batch")
+            params = module.init(jax.random.PRNGKey(cfg.seed), *init_batch)
+        self.params_template = params
+        vec, self.unravel = flatten_params(params)
+        cfg = cfg.replace(grad_size=int(vec.shape[0])).validate()
+        self.cfg = cfg
+
+        self.mesh = mesh if mesh is not None else make_client_mesh()
+        self.num_clients = cfg.resolved_num_clients(num_clients)
+
+        self._loss_train = loss_train
+        self._loss_val = loss_val if loss_val is not None else loss_train
+
+        self._train_round, self._eval_batch = fround.make_round_fns(
+            self._loss_train, self.unravel, cfg, self.mesh)
+        if loss_val is not None:
+            cfg_val = cfg
+            _, self._eval_batch = fround.make_round_fns(
+                self._loss_val, self.unravel, cfg_val, self.mesh)
+
+        self.server = fround.init_server_state(cfg, vec)
+        self.clients = fround.init_client_state(
+            cfg, self.num_clients, vec, mesh=self.mesh)
+
+        self.accountant = CommAccountant(cfg, self.num_clients)
+        self._prev_change_words: Optional[np.ndarray] = None
+        self._pack_bits = jax.jit(pack_change_bits)
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._optimizer: Optional["FedOptimizer"] = None
+        # per-parameter lr scale vector (Fixup param groups,
+        # reference fed_aggregator.py:411-427); None -> scalar lr
+        self.lr_scale_vec = (None if lr_scale_vec is None
+                             else jnp.asarray(lr_scale_vec))
+
+    # -- reference API surface -------------------------------------------
+    def train(self, training: bool):
+        self.training = training
+
+    def __call__(self, batch):
+        if self.training:
+            return self._call_train(batch)
+        return self._call_val(batch)
+
+    def finalize(self):
+        """No worker processes to tear down (reference needed this at
+        fed_aggregator.py:196-203); kept for API parity."""
+
+    @property
+    def ps_weights(self) -> jax.Array:
+        return self.server.ps_weights
+
+    def state_dict(self):
+        """Current PS weights as the model's parameter pytree
+        (reference materializes this through a __getattr__ hack,
+        fed_aggregator.py:372-376)."""
+        return self.unravel(self.server.ps_weights)
+
+    # -- internals --------------------------------------------------------
+    def _lr(self):
+        if self._optimizer is None:
+            raise RuntimeError("attach a FedOptimizer before training")
+        lr = self._optimizer.param_groups[0]["lr"]
+        if self.cfg.mode == "fedavg":
+            return lr  # clients apply it locally; server uses lr=1
+        if self.lr_scale_vec is not None:
+            return lr * self.lr_scale_vec
+        return lr
+
+    def _call_train(self, batch):
+        client_ids, data, mask = batch
+        prev_weights = self.server.ps_weights
+
+        self.server, self.clients, metrics = self._train_round(
+            self.server, self.clients,
+            fround.RoundBatch(jnp.asarray(client_ids),
+                              tuple(jnp.asarray(d) for d in data),
+                              jnp.asarray(mask)),
+            self._lr(), self._key)
+
+        # communication accounting (host side, overlapped with device)
+        download, upload = self.accountant.record_round(
+            np.asarray(client_ids), self._prev_change_words)
+        self._prev_change_words = np.asarray(
+            self._pack_bits(self.server.ps_weights - prev_weights))
+
+        losses = np.asarray(metrics.losses)
+        mets = [np.asarray(m) for m in metrics.metrics]
+        return [losses, *mets, download, upload]
+
+    def _call_val(self, batch):
+        data, mask = batch
+        loss, mets, count = self._eval_batch(
+            self.server.ps_weights,
+            tuple(jnp.asarray(d) for d in data), jnp.asarray(mask))
+        return [np.asarray(loss), *[np.asarray(m) for m in mets],
+                np.asarray(count)]
+
+
+class FedOptimizer:
+    """Holds param_groups for LR scheduling (reference FedOptimizer,
+    fed_aggregator.py:384-458). The actual server update runs fused
+    inside FedModel's round program; see module docstring."""
+
+    def __init__(self, model: FedModel, cfg: Optional[Config] = None):
+        self.model = model
+        self.cfg = cfg or model.cfg
+        self.param_groups = [{"lr": 0.0}]
+        model._optimizer = self
+
+    def step(self):
+        """Host-side no-op kept for reference call-pattern parity; the
+        weight update already happened inside model(batch)."""
+
+    def zero_grad(self):
+        raise NotImplementedError(
+            "gradients are per-round temporaries in the fused design")

@@ -91,8 +91,10 @@ class ResNet9(nn.Module):
         x = ConvBlock(ch["layer2"], self.do_batchnorm, pool=True)(x)
         x = ConvBlock(ch["layer3"], self.do_batchnorm, pool=True)(x)
         x = Residual(ch["layer3"], self.do_batchnorm)(x)
-        x = nn.max_pool(x, (4, 4), strides=(4, 4))
-        x = x.reshape((x.shape[0], -1))
+        # global max pool: equals the reference's MaxPool2d(4) on the
+        # 4x4 CIFAR feature map, and stays well-defined for the 3x3
+        # map that 28x28 EMNIST inputs produce
+        x = x.max(axis=(1, 2))
         x = nn.Dense(self.num_classes, use_bias=False,
                      name="head")(x)
         return x * self.weight

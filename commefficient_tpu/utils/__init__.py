@@ -1,0 +1,4 @@
+from commefficient_tpu.utils.schedules import PiecewiseLinear, Exp, LambdaLR  # noqa: F401
+from commefficient_tpu.utils.logging import (  # noqa: F401
+    Logger, TableLogger, TSVLogger, Timer, make_logdir,
+)

@@ -1,0 +1,72 @@
+"""Run logging: stdout table, TSV, run dirs, wall-clock timing
+(reference: CommEfficient/utils.py:14-99 Logger/TableLogger/TSVLogger/
+Timer, make_logdir at :51-64; TensorBoard hookup is optional at the
+driver level, cv_train.py:150-158)."""
+from __future__ import annotations
+
+import os
+import time
+from datetime import datetime
+
+import numpy as np
+
+
+class Logger:
+    def _p(self, msg, args=None):
+        print(msg.format(args) if args is not None else msg)
+    debug = info = warn = error = critical = _p
+
+
+class TableLogger:
+    """Fixed-width column table on stdout; header from the first row."""
+
+    def append(self, output: dict):
+        if not hasattr(self, "keys"):
+            self.keys = list(output.keys())
+            print(*(f"{k:>12s}" for k in self.keys))
+        row = []
+        for k in self.keys:
+            v = output[k]
+            if isinstance(v, (float, np.floating)):
+                row.append(f"{v:12.4f}")
+            else:
+                row.append(f"{v!s:>12}")
+        print(*row)
+
+
+class TSVLogger:
+    def __init__(self):
+        self.log = ["epoch,hours,top1Accuracy"]
+
+    def append(self, output: dict):
+        self.log.append("{},{:.8f},{:.2f}".format(
+            output["epoch"], output["total_time"] / 3600,
+            output["test_acc"] * 100))
+
+    def __str__(self):
+        return "\n".join(self.log)
+
+
+class Timer:
+    def __init__(self):
+        self.times = [time.time()]
+        self.total_time = 0.0
+
+    def __call__(self, include_in_total=True):
+        self.times.append(time.time())
+        dt = self.times[-1] - self.times[-2]
+        if include_in_total:
+            self.total_time += dt
+        return dt
+
+
+def make_logdir(cfg) -> str:
+    mode = cfg.mode
+    sketch_str = (f"{mode}: {cfg.num_rows} x {cfg.num_cols}"
+                  if mode == "sketch" else f"{mode}")
+    k_str = (f"k: {cfg.k}"
+             if mode in ("sketch", "true_topk", "local_topk") else "")
+    clients_str = f"{cfg.num_workers}/{cfg.num_clients}"
+    now = datetime.now().strftime("%b%d_%H-%M-%S")
+    return os.path.join(
+        "runs", f"{now}_{clients_str}_{sketch_str}_{k_str}")

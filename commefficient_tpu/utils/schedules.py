@@ -1,0 +1,63 @@
+"""Learning-rate schedules (reference: CommEfficient/utils.py:26-35
+`PiecewiseLinear` / `Exp`; driven through LambdaLR against the fed
+optimizer at cv_train.py:392-404 and gpt2_train.py:302-307).
+
+Schedules are plain callables t -> lr; `LambdaLR` reproduces the
+torch scheduler's step()/get_last_lr() driver contract so training
+loops read identically.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Sequence
+
+import numpy as np
+
+
+class PiecewiseLinear(NamedTuple):
+    knots: Sequence[float]
+    vals: Sequence[float]
+
+    def __call__(self, t):
+        return float(np.interp([t], self.knots, self.vals)[0])
+
+
+class Exp(NamedTuple):
+    warmup_epochs: float
+    amplitude: float
+    decay_len: float
+
+    def __call__(self, t):
+        if t < self.warmup_epochs:
+            return float(np.interp([t], [0, self.warmup_epochs],
+                                   [0, self.amplitude])[0])
+        return float(self.amplitude
+                     * 10 ** (-(t - self.warmup_epochs) / self.decay_len))
+
+
+class LambdaLR:
+    """step()/get_last_lr() driver, one per optimizer param group."""
+
+    def __init__(self, optimizer, lr_lambda: Callable[[int], float]):
+        self.optimizer = optimizer
+        self.lr_lambda = lr_lambda
+        self.step_count = 0
+        self._apply()
+
+    def _apply(self):
+        lr = self.lr_lambda(self.step_count)
+        for group in self.optimizer.param_groups:
+            group["lr"] = lr * group.get("lr_scale", 1.0)
+
+    def step(self):
+        self.step_count += 1
+        self._apply()
+
+    def get_last_lr(self):
+        return [g["lr"] for g in self.optimizer.param_groups]
+
+    def state_dict(self):
+        return {"step_count": self.step_count}
+
+    def load_state_dict(self, state):
+        self.step_count = int(state["step_count"])
+        self._apply()

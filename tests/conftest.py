@@ -6,15 +6,20 @@ here every sharded code path runs on host-emulated devices.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+# The session interpreter may have imported jax already (sitecustomize
+# registers the real-TPU tunnel plugin), freezing jax_platforms to the
+# tunnel; override through config, which wins over the captured env.
+# Tests must never claim the single real TPU.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402

@@ -1,0 +1,93 @@
+"""Communication accounting tests (reference semantics:
+fed_aggregator.py:170-299)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated.accounting import (
+    CommAccountant, pack_change_bits,
+)
+
+
+def test_pack_change_bits():
+    v = jnp.zeros(70).at[jnp.array([0, 31, 32, 69])].set(1.0)
+    words = np.asarray(pack_change_bits(v))
+    assert words.shape == (3,)
+    assert words[0] == (1 | (1 << 31))
+    assert words[1] == 1
+    assert words[2] == (1 << 5)
+
+
+def cfg_for(**kw):
+    base = dict(mode="uncompressed", grad_size=64, num_workers=2,
+                local_momentum=0.0, num_epochs=10.0, local_batch_size=4)
+    base.update(kw)
+    return Config(**base)
+
+
+def test_upload_bytes_per_mode():
+    for mode, floats in [("uncompressed", 64), ("true_topk", 64),
+                         ("fedavg", 64), ("local_topk", 5)]:
+        kw = {}
+        if mode == "fedavg":
+            kw = dict(local_batch_size=-1, error_type="none")
+        if mode == "true_topk":
+            kw = dict(error_type="virtual")
+        if mode == "local_topk":
+            kw = dict(error_type="local")
+        acct = CommAccountant(cfg_for(mode=mode, k=5, **kw), num_clients=10)
+        _, up = acct.record_round(np.array([1, 3]), None)
+        assert up[1] == up[3] == 4.0 * floats
+        assert up[0] == 0
+    acct = CommAccountant(
+        cfg_for(mode="sketch", num_rows=3, num_cols=7,
+                error_type="virtual", local_momentum=0.0),
+        num_clients=10)
+    _, up = acct.record_round(np.array([0]), None)
+    assert up[0] == 4.0 * 21
+
+
+def test_download_first_round_free():
+    acct = CommAccountant(cfg_for(), num_clients=4)
+    down, _ = acct.record_round(np.array([0, 1]), None)
+    np.testing.assert_allclose(down, 0.0)
+
+
+def test_download_counts_changed_coords():
+    acct = CommAccountant(cfg_for(num_workers=2), num_clients=4)
+    acct.record_round(np.array([0, 1]), None)
+    # round 1's update changed 3 coords
+    change1 = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.array([1, 2, 3])].set(1.0)))
+    # round 2: client 0 re-participates (stale 1 round -> 3 coords),
+    # client 2 joined at init and is stale 1 round too
+    down, _ = acct.record_round(np.array([0, 2]), change1)
+    assert down[0] == 4.0 * 3
+    assert down[2] == 4.0 * 3
+    # round 3: client 1 last participated in round 1 -> union of
+    # rounds 2-3 changes
+    change2 = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.array([3, 10])].set(1.0)))
+    down, _ = acct.record_round(np.array([1]), change2)
+    assert down[1] == 4.0 * 4  # {1,2,3} | {3,10} = 4 coords
+
+
+def test_cheap_path_accumulates_since_init():
+    cfg = cfg_for(num_epochs=1.0, local_batch_size=-1, mode="fedavg",
+                  error_type="none")
+    acct = CommAccountant(cfg, num_clients=4)
+    assert acct.cheap
+    acct.record_round(np.array([0]), None)
+    c1 = np.asarray(pack_change_bits(jnp.zeros(64).at[0].set(1.0)))
+    down, _ = acct.record_round(np.array([1]), c1)
+    assert down[1] == 4.0
+    c2 = np.asarray(pack_change_bits(jnp.zeros(64).at[5].set(1.0)))
+    down, _ = acct.record_round(np.array([2]), c2)
+    assert down[2] == 8.0  # coords {0, 5} changed since init
+
+
+def test_staleness_clamped_to_deque():
+    cfg = cfg_for(num_workers=2)
+    acct = CommAccountant(cfg, num_clients=4)  # maxlen = 10/(2/4) = 20
+    assert acct.changes.maxlen == 20
