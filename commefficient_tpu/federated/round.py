@@ -135,6 +135,12 @@ def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
         (vmap), locally sum their compressed updates, psum across the
         clients axis (the reference's per-GPU client loop
         fed_worker.py:60-131 + NCCL reduce :138)."""
+        # Cast the replicated weights to shard-varying before any
+        # jax.grad: differentiating w.r.t. an *unvarying* operand under
+        # shard_map makes JAX psum the cotangent across shards (correct
+        # for grad-through-shard_map, wrong here — each client needs its
+        # own local gradient, not the cross-client sum).
+        ps_weights = jax.lax.pcast(ps_weights, "clients", to="varying")
 
         def one_client(cdata, cmask, err, vel, w_stale, key):
             if cfg.do_topk_down:

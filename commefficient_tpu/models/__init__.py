@@ -48,9 +48,15 @@ def build_model(name: str, **config):
     except KeyError:
         raise ValueError(f"unknown model {name!r}; known: {model_names()}")
 
-    if dataclasses.is_dataclass(cls):
-        fields = {f.name for f in dataclasses.fields(cls)}
+    # **kw factory functions declare the dataclass they forward to via
+    # __wrapped__ and the keywords they bind via __bound_fields__;
+    # introspect those for the real forwardable field set
+    target = getattr(cls, "__wrapped__", cls)
+    if dataclasses.is_dataclass(target):
+        fields = {f.name for f in dataclasses.fields(target)}
     else:
-        fields = set(inspect.signature(cls).parameters)
+        fields = set(inspect.signature(target).parameters)
+    fields -= getattr(cls, "__bound_fields__", set())
+    fields -= {"name", "parent"}  # flax.linen internals
     kept = {k: v for k, v in config.items() if k in fields}
     return cls(**kept)

@@ -194,3 +194,24 @@ def resnet101ln(**kw):
 def fixup_resnet50(**kw):
     """(capability of reference models/fixup_resnet.py FixupResNet50)"""
     return ResNet(stage_sizes=(3, 4, 6, 3), block="fixup_bottleneck", **kw)
+
+
+# Mark each **kw factory with the dataclass it forwards to and the
+# keywords it binds itself, so the model registry can filter a shared
+# model_config dict against the real field set (the reference passes
+# one config dict to every model class, cv_train.py:329-364) without
+# forwarding keys the factory already fixes.
+for _f, _bound in (
+    (resnet18, {"stage_sizes", "block"}),
+    (resnet34, {"stage_sizes", "block"}),
+    (resnet50, {"stage_sizes", "block"}),
+    (resnet101, {"stage_sizes", "block"}),
+    (resnet152, {"stage_sizes", "block"}),
+    (wide_resnet50_2, {"stage_sizes", "block", "width"}),
+    (wide_resnet101_2, {"stage_sizes", "block", "width"}),
+    (resnet101ln, {"stage_sizes", "block", "norm"}),
+    (fixup_resnet50, {"stage_sizes", "block"}),
+):
+    _f.__wrapped__ = ResNet
+    _f.__bound_fields__ = _bound
+del _f, _bound
