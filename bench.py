@@ -1,0 +1,149 @@
+"""Benchmark: wall-clock per federated round, flagship config.
+
+BASELINE.json config #2: ResNet9 on CIFAR10-shaped data, count-sketch
+compression (default geometry: 5 x 500k table, 20 blocks, k=50k,
+reference utils.py:142-145) + virtual error feedback + virtual
+momentum, 8 participating clients per round.
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is
+reported against an analytic stand-in: the reference runs one worker
+process per GPU with the per-client loop serialized on each GPU
+(fed_worker.py:60), so its round time is bounded below by
+num_workers x per-client fwd/bwd; ours runs all clients in one jitted
+program. vs_baseline = analytic_reference_round_ms / measured_round_ms
+computed on THIS hardware from a measured single-client fwd/bwd step,
+i.e. >1.0 means faster than a faithful per-client-serialized port.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+
+# honor an explicit platform request: the session interpreter's
+# sitecustomize may have imported jax already and pinned the TPU
+# tunnel plugin, freezing the env-var route (same workaround as
+# tests/conftest.py)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+NUM_WORKERS = int(os.environ.get("BENCH_WORKERS", "8"))
+LOCAL_BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+ROUNDS = int(os.environ.get("BENCH_ROUNDS", "20"))
+# BENCH_SMALL=1 shrinks model + sketch geometry (CPU smoke of the
+# bench mechanism; the reported numbers are always full-size TPU runs)
+SMALL = os.environ.get("BENCH_SMALL", "") == "1"
+
+
+def main():
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.federated import round as fround
+    from commefficient_tpu.models import ResNet9
+    from commefficient_tpu.ops.flat import flatten_params
+    from commefficient_tpu.parallel.mesh import make_client_mesh
+
+    mesh = make_client_mesh(min(len(jax.devices()), NUM_WORKERS))
+
+    channels = ({"prep": 8, "layer1": 8, "layer2": 8, "layer3": 8}
+                if SMALL else None)
+    model = ResNet9(num_classes=10, channels=channels)
+    x0 = jnp.zeros((LOCAL_BATCH, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x0)
+    vec, unravel = flatten_params(params)
+    D = int(vec.shape[0])
+
+    cfg = Config(
+        mode="sketch",
+        k=500 if SMALL else 50_000,
+        num_rows=5,
+        num_cols=max(256, D // 13) if SMALL else 500_000,
+        num_blocks=20, error_type="virtual", virtual_momentum=0.9,
+        local_momentum=0.0, weight_decay=5e-4, microbatch_size=-1,
+        num_workers=NUM_WORKERS, num_clients=10 * NUM_WORKERS,
+        grad_size=D,
+    ).validate()
+
+    def loss_fn(params, batch, mask):
+        xb, yb = batch
+        logits = model.apply(params, xb)
+        logp = jax.nn.log_softmax(logits)
+        per_ex = -jnp.take_along_axis(logp, yb[:, None], axis=1)[:, 0]
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per_ex * mask).sum() / denom
+        acc = ((logits.argmax(-1) == yb) * mask).sum() / denom
+        return loss, (acc,)
+
+    train_round, _ = fround.make_round_fns(loss_fn, unravel, cfg, mesh)
+    server = fround.init_server_state(cfg, vec)
+    clients = fround.init_client_state(cfg, cfg.resolved_num_clients(),
+                                       vec, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(
+        rng.randn(NUM_WORKERS, LOCAL_BATCH, 32, 32, 3).astype(np.float32))
+    y = jnp.asarray(
+        rng.randint(0, 10, (NUM_WORKERS, LOCAL_BATCH)).astype(np.int32))
+    batch = fround.RoundBatch(
+        jnp.arange(NUM_WORKERS, dtype=jnp.int32), (x, y),
+        jnp.ones((NUM_WORKERS, LOCAL_BATCH), jnp.float32))
+    key = jax.random.PRNGKey(0)
+
+    # an epoch-sized span of rounds runs as ONE scanned device program
+    # (round.train_rounds); sync via a host transfer of a tiny array,
+    # not block_until_ready — the latter returns immediately on the
+    # axon tunnel platform, producing fantasy timings
+    batches = fround.RoundBatch(
+        jnp.broadcast_to(batch.client_ids, (ROUNDS,) + batch.client_ids.shape),
+        tuple(jnp.broadcast_to(d, (ROUNDS,) + d.shape) for d in batch.data),
+        jnp.broadcast_to(batch.mask, (ROUNDS,) + batch.mask.shape))
+    lrs = jnp.full((ROUNDS,), 0.1)
+
+    run = train_round.train_rounds
+    server2, clients2, m, _ = run(server, clients, batches, lrs, key)  # compile
+    float(np.asarray(m.losses).mean())
+
+    t0 = time.perf_counter()
+    server2, clients2, m, _ = run(server, clients, batches, lrs, key)
+    float(np.asarray(m.losses).mean())
+    float(np.asarray(server2.ps_weights[0]))
+    round_ms = (time.perf_counter() - t0) / ROUNDS * 1e3
+
+    # analytic reference stand-in: per-client serialized fwd/bwd on this
+    # same hardware (measured), x num_workers per round
+    def one_client_step(params_vec, xb, yb):
+        def loss(v):
+            l, _ = loss_fn(unravel(v), (xb, yb), jnp.ones(xb.shape[0]))
+            return l
+        return jax.grad(loss)(params_vec)
+
+    @jax.jit
+    def serial_steps(params_vec, xb, yb):
+        def body(v, _):
+            return v - 1e-6 * one_client_step(v, xb, yb), None
+        v, _ = jax.lax.scan(body, params_vec, None, length=ROUNDS)
+        return v
+
+    v2 = serial_steps(vec, x[0], y[0])
+    float(np.asarray(v2[0]))
+    t0 = time.perf_counter()
+    v2 = serial_steps(vec, x[0], y[0])
+    float(np.asarray(v2[0]))
+    ref_round_ms = (time.perf_counter() - t0) / ROUNDS * 1e3 * NUM_WORKERS
+
+    print(json.dumps({
+        "metric": "cifar10_resnet9_sketch_round_time",
+        "value": round(round_ms, 3),
+        "unit": "ms/round",
+        "vs_baseline": round(ref_round_ms / round_ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -150,6 +150,47 @@ class FedModel:
         mets = [np.asarray(m) for m in metrics.metrics]
         return [losses, *mets, download, upload]
 
+    def run_rounds(self, client_ids, data, mask, lrs, account: bool = True):
+        """Run N federated rounds as ONE device program (scanned; see
+        round.train_rounds). client_ids: [N, W]; data: pytree of
+        [N, W, B, ...]; mask: [N, W, B]; lrs: [N].
+
+        Returns (losses [N, W], metrics [N, W]..., download, upload)
+        with download/upload summed over the span (zeros when
+        account=False, which also skips the bitset transfer)."""
+        prev_weights = self.server.ps_weights
+        lrs = jnp.asarray(lrs)
+        if self.lr_scale_vec is not None and self.cfg.mode != "fedavg":
+            # per-parameter LR scaling (Fixup param groups) — same
+            # routing _lr() applies on the single-round path
+            lrs = lrs[:, None] * self.lr_scale_vec[None, :]
+        self.server, self.clients, metrics, bits = (
+            self._train_round.train_rounds(
+                self.server, self.clients,
+                fround.RoundBatch(jnp.asarray(client_ids),
+                                  tuple(jnp.asarray(d) for d in data),
+                                  jnp.asarray(mask)),
+                lrs, self._key))
+
+        download = np.zeros(self.num_clients)
+        upload = np.zeros(self.num_clients)
+        if account:
+            bits_host = np.asarray(bits)
+            ids_host = np.asarray(client_ids)
+            for n in range(ids_host.shape[0]):
+                d, u = self.accountant.record_round(
+                    ids_host[n], self._prev_change_words)
+                self._prev_change_words = bits_host[n]
+                download += d
+                upload += u
+        else:
+            self._prev_change_words = np.asarray(
+                self._pack_bits(self.server.ps_weights - prev_weights))
+
+        losses = np.asarray(metrics.losses)
+        mets = [np.asarray(m) for m in metrics.metrics]
+        return [losses, *mets, download, upload]
+
     def _call_val(self, batch):
         data, mask = batch
         loss, mets, count = self._eval_batch(

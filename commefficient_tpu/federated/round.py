@@ -182,9 +182,8 @@ def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
     )
 
     # ---------------- full train round ----------------------------------
-    @jax.jit
-    def train_round(server: ServerState, clients: ClientState,
-                    batch: RoundBatch, lr, key):
+    def round_step(server: ServerState, clients: ClientState,
+                   batch: RoundBatch, lr, key):
         num_workers = batch.client_ids.shape[0]
         if num_workers % n_shards != 0:
             raise ValueError(
@@ -243,6 +242,39 @@ def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
 
         return new_server, new_clients, RoundMetrics(losses, metrics, counts)
 
+    _train_round_jit = jax.jit(round_step)
+
+    # ---------------- scanned multi-round driver -------------------------
+    @jax.jit
+    def train_rounds(server: ServerState, clients: ClientState,
+                     batches: RoundBatch, lrs, key):
+        """Run N rounds as ONE device program (`lax.scan` over rounds):
+        `batches` is a RoundBatch whose fields carry a leading [N]
+        axis, `lrs` is [N]. Amortizes host dispatch — the reference
+        pays a full host round-trip (queues + NCCL + shared-memory
+        writeback, fed_aggregator.py:303-332) every round by
+        construction; here an entire epoch can stay on-device.
+
+        Also returns the per-round packed change bitset of the weight
+        update ([N, D/32] uint32) so host-side communication
+        accounting can replay the rounds without the weights ever
+        leaving the device (see accounting.pack_change_bits).
+        """
+        from commefficient_tpu.federated.accounting import pack_change_bits
+
+        def body(carry, xs):
+            server, clients = carry
+            batch, lr = xs
+            prev = server.ps_weights
+            server, clients, metrics = round_step(
+                server, clients, batch, lr, key)
+            bits = pack_change_bits(server.ps_weights - prev)
+            return (server, clients), (metrics, bits)
+
+        (server, clients), (metrics, bits) = jax.lax.scan(
+            body, (server, clients), (batches, lrs))
+        return server, clients, metrics, bits
+
     # ---------------- eval ----------------------------------------------
     def shard_eval(ps_weights, data, mask):
         def one_shard(b, m):
@@ -264,4 +296,13 @@ def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
         reference _call_val (fed_aggregator.py:337-364)."""
         return shard_eval_mapped(ps_weights, data, mask)
 
-    return train_round, eval_batch
+    class TrainRound:
+        """Callable single-round step; `.train_rounds` runs a whole
+        scanned span of rounds in one device program."""
+
+        def __call__(self, server, clients, batch, lr, key):
+            return _train_round_jit(server, clients, batch, lr, key)
+
+    handle = TrainRound()
+    handle.train_rounds = train_rounds
+    return handle, eval_batch

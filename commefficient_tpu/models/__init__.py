@@ -49,9 +49,9 @@ def build_model(name: str, **config):
         raise ValueError(f"unknown model {name!r}; known: {model_names()}")
 
     # **kw factory functions declare the dataclass they forward to via
-    # __wrapped__ and the keywords they bind via __bound_fields__;
+    # __forwards_to__ and the keywords they bind via __bound_fields__;
     # introspect those for the real forwardable field set
-    target = getattr(cls, "__wrapped__", cls)
+    target = getattr(cls, "__forwards_to__", cls)
     if dataclasses.is_dataclass(target):
         fields = {f.name for f in dataclasses.fields(target)}
     else:

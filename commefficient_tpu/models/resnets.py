@@ -212,6 +212,6 @@ for _f, _bound in (
     (resnet101ln, {"stage_sizes", "block", "norm"}),
     (fixup_resnet50, {"stage_sizes", "block"}),
 ):
-    _f.__wrapped__ = ResNet
+    _f.__forwards_to__ = ResNet
     _f.__bound_fields__ = _bound
 del _f, _bound

@@ -5,4 +5,4 @@ from commefficient_tpu.ops.flat import (  # noqa: F401
     global_norm_clip,
     dp_noise,
 )
-from commefficient_tpu.ops.sketch import CSVec, CSVecHashes  # noqa: F401
+from commefficient_tpu.ops.sketch import CSVec  # noqa: F401

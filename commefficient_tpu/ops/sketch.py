@@ -6,27 +6,43 @@ CommEfficient/fed_worker.py:312-320 and fed_aggregator.py:464-467,
 584-595): an r x c count-sketch of a length-d vector supporting
 linear accumulation, top-k heavy-hitter recovery, and L2 estimation.
 
-TPU-first design decisions:
-  * No stored hash index arrays (csvec materializes r*d hash tables on
-    the GPU and splits them into `numBlocks` chunks to fit memory).
-    Here bucket/sign hashes are *computed on the fly* from the
-    coordinate index with a murmur3-style integer mixer — pure uint32
-    VPU arithmetic, zero HBM footprint, and `num_blocks` degrades into
-    a pure scheduling knob (chunk count for the encode/decode scans)
-    that cannot change results.
-  * Encode is a blockwise `lax.scan` of scatter-adds; decode-top-k is
-    a blockwise `lax.scan` holding a running top-k buffer, so the d
-    median-estimates are never materialized at once (SURVEY.md §7.3
-    hard part #1: d = O(1e8) must not materialize).
-  * Everything is a pure function of (table, static hash params), so
+TPU-first design. csvec hashes every coordinate independently, which
+on an accelerator means r*d-element scatter (encode) and gather
+(decode) through HBM — measured at ~600 ms per op for d=6.6M on a
+v5e. Both are eliminated by choosing a hash family that vector
+hardware can evaluate with contiguous memory ops only (~2-5 ms, i.e.
+memory-bound optimal):
+
+  * View the vector as B = ceil(d/c) contiguous chunks of length c.
+    Row j's bucket hash is a random cyclic rotation per chunk:
+        bucket_j(i) = ((i mod c) + offset[j, i // c]) mod c
+    Encode row j = sum over chunks of rotate(sign * chunk): pure
+    slices and adds. Decode-estimate inverts the rotations.
+  * Signs factor as sign_j(i) = eps_j[i mod c] * delta_j[i // c] with
+    eps ([r, c]) and delta ([r, B]) i.i.d. Rademacher drawn once from
+    the seed. TPUs multiply floats far faster than they evaluate
+    integer hash mixers (int multiplies are emulated), and the eps
+    table is 4rc bytes regardless of d.
+  * Validity: two coords in the same chunk never collide (same
+    rotation — strictly better than the classic family). Coords in
+    different chunks b != b' collide with probability exactly 1/c over
+    the independent uniform offsets, and their sign product
+    eps(p)eps(p')delta(b)delta(b') (or delta(b)delta(b') when p = p')
+    has zero mean, so estimates are unbiased with variance
+    <= ||v||^2/c per row; median-of-rows and heavy-hitter recovery
+    guarantees carry over unchanged.
+  * Everything is a pure function of (table, static geometry), so
     sketches are linear by construction: psum of worker tables over
     the client mesh axis == the sketch of the summed gradient. That
     linearity is the whole point of FetchSGD, and it is what lets the
     reference's lone NCCL reduce (fed_worker.py:138) become a single
     `lax.psum` here.
+  * `num_blocks` (csvec's GPU-memory workaround) is accepted for API
+    parity but cannot change results; chunking here is intrinsic
+    (B = ceil(d/c)).
 
-The sketch state is just a jnp array [r, c]; this class is a frozen,
-hashable bundle of static geometry + hash salts, safe to close over
+The sketch state is just a jnp array [r, c]; this class is a frozen
+bundle of static geometry + sign/offset tables, safe to close over
 under jit.
 """
 from __future__ import annotations
@@ -38,38 +54,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_M32 = np.uint32(0xFFFFFFFF)
 
-
-def _mix32(x: jax.Array) -> jax.Array:
-    """murmur3 finalizer: a fast, well-distributed uint32->uint32 mixer."""
-    x = x ^ (x >> 16)
-    x = x * np.uint32(0x85EBCA6B)
-    x = x ^ (x >> 13)
-    x = x * np.uint32(0xC2B2AE35)
-    x = x ^ (x >> 16)
-    return x
-
-
-@dataclasses.dataclass(frozen=True)
-class CSVecHashes:
-    """Per-row hash salts, generated deterministically from `seed` so
-    that every participant (every client shard, and the server) builds
-    the identical sketch geometry — the analogue of csvec seeding its
-    hash generation with a fixed manual seed."""
-    bucket_salts: Tuple[int, ...]
-    sign_salts: Tuple[int, ...]
-
-    @staticmethod
-    def make(r: int, seed: int) -> "CSVecHashes":
-        rng = np.random.RandomState(seed)
-        return CSVecHashes(
-            bucket_salts=tuple(int(s) for s in rng.randint(1, 2**31, size=r)),
-            sign_salts=tuple(int(s) for s in rng.randint(1, 2**31, size=r)),
-        )
-
-
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class CSVec:
     """Count-sketch geometry: d-dim vectors into an [r, c] table.
 
@@ -82,30 +68,27 @@ class CSVec:
     d: int
     c: int
     r: int
-    num_blocks: int = 1
+    num_blocks: int = 1   # accepted for parity; results are invariant
     seed: int = 42
 
     def __post_init__(self):
-        object.__setattr__(self, "hashes", CSVecHashes.make(self.r, self.seed))
-
-    # --- hashing ---------------------------------------------------------
-    def hash_indices(self, idx: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        """Buckets [r, n] (int32 in [0, c)) and signs [r, n] (+-1 f32)
-        for an int32 index array [n]."""
-        iu = idx.astype(jnp.uint32)
-        buckets = []
-        signs = []
-        for j in range(self.r):
-            hb = _mix32(iu ^ np.uint32(self.hashes.bucket_salts[j]))
-            hs = _mix32(iu ^ np.uint32(self.hashes.sign_salts[j]))
-            buckets.append((hb % np.uint32(self.c)).astype(jnp.int32))
-            signs.append(1.0 - 2.0 * (hs & np.uint32(1)).astype(jnp.float32))
-        return jnp.stack(buckets), jnp.stack(signs)
+        rng = np.random.RandomState(self.seed)
+        B = self.n_chunks
+        object.__setattr__(
+            self, "_offsets", rng.randint(0, self.c, size=(self.r, B))
+            .astype(np.int32))
+        object.__setattr__(
+            self, "_eps",
+            rng.choice([-1.0, 1.0], size=(self.r, self.c))
+            .astype(np.float32))
+        object.__setattr__(
+            self, "_delta",
+            rng.choice([-1.0, 1.0], size=(self.r, B)).astype(np.float32))
 
     # --- geometry helpers ------------------------------------------------
     @property
-    def _chunk(self) -> int:
-        return -(-self.d // max(self.num_blocks, 1))
+    def n_chunks(self) -> int:
+        return -(-self.d // self.c)
 
     @property
     def table_shape(self) -> Tuple[int, int]:
@@ -114,36 +97,56 @@ class CSVec:
     def zeros(self) -> jax.Array:
         return jnp.zeros(self.table_shape, jnp.float32)
 
+    def _rotate(self, row: jax.Array, shift) -> jax.Array:
+        """out[p] = row[(p - shift) mod c]: two contiguous slices."""
+        doubled = jnp.concatenate([row, row], axis=-1)
+        return jax.lax.dynamic_slice_in_dim(
+            doubled, self.c - shift, self.c, axis=-1)
+
+    def _unrotate(self, row: jax.Array, shift) -> jax.Array:
+        """out[p] = row[(p + shift) mod c] (inverse of _rotate)."""
+        doubled = jnp.concatenate([row, row], axis=-1)
+        return jax.lax.dynamic_slice_in_dim(doubled, shift, self.c, axis=-1)
+
+    def _padded_chunks(self, vec: jax.Array) -> jax.Array:
+        B = self.n_chunks
+        pad = B * self.c - self.d
+        if pad:
+            vec = jnp.pad(vec, (0, pad))
+        return vec.reshape(B, self.c)
+
+    # --- hashing (for sparse / per-coordinate paths) ---------------------
+    def hash_indices(self, idx: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Buckets [r, n] (int32 in [0, c)) and signs [r, n] (+-1 f32)
+        for an int32 index array [n]. Out-of-range indices get an
+        arbitrary valid bucket (callers mask their values)."""
+        safe = jnp.clip(idx, 0, self.d - 1)
+        b = (safe // self.c).astype(jnp.int32)             # chunk [n]
+        p = (safe % self.c).astype(jnp.int32)              # position [n]
+        off = jnp.asarray(self._offsets)[:, b]             # [r, n]
+        buckets = (p[None, :] + off) % self.c
+        signs = jnp.asarray(self._eps)[:, p] * jnp.asarray(self._delta)[:, b]
+        return buckets.astype(jnp.int32), signs
+
     # --- encode ----------------------------------------------------------
     def encode(self, vec: jax.Array) -> jax.Array:
-        """Sketch a dense [d] vector into an [r, c] table."""
-        chunk = self._chunk
-        n_blocks = -(-self.d // chunk)
-        row_ids = jnp.repeat(jnp.arange(self.r, dtype=jnp.int32), chunk)
+        """Sketch a dense [d] vector into an [r, c] table: one
+        multiply + rotate + add per (row, chunk), all contiguous."""
+        chunks = self._padded_chunks(vec)                  # [B, c]
+        eps = jnp.asarray(self._eps)                       # [r, c]
 
-        def body(table, b):
-            start = b * chunk
-            i = start + jnp.arange(chunk, dtype=jnp.int32)
-            valid = (i < self.d).astype(jnp.float32)
-            vals = jax.lax.dynamic_slice_in_dim(
-                self._padded(vec), start, chunk) * valid
-            buckets, signs = self.hash_indices(i)
-            contrib = (signs * vals[None, :]).reshape(-1)
-            table = table.at[row_ids, buckets.reshape(-1)].add(contrib)
-            return table, None
+        def body(table, xs):
+            chunk, off_b, delta_b = xs                     # [c], [r], [r]
+            signed = eps * chunk[None, :] * delta_b[:, None]   # [r, c]
+            rows = [self._rotate(signed[j], off_b[j]) for j in range(self.r)]
+            return table + jnp.stack(rows), None
 
-        # init carry derived from `vec` (not a fresh constant) so that
-        # under shard_map the carry inherits vec's varying-axes type
         init = jnp.zeros_like(vec, shape=self.table_shape)
         table, _ = jax.lax.scan(
-            body, init, jnp.arange(n_blocks, dtype=jnp.int32))
+            body, init,
+            (chunks, jnp.asarray(self._offsets).T,
+             jnp.asarray(self._delta).T))
         return table
-
-    def _padded(self, vec: jax.Array) -> jax.Array:
-        chunk = self._chunk
-        n_blocks = -(-self.d // chunk)
-        pad = n_blocks * chunk - self.d
-        return jnp.pad(vec, (0, pad)) if pad else vec
 
     def encode_sparse(self, indices: jax.Array, values: jax.Array) -> jax.Array:
         """Sketch a sparse vector given as (indices [n], values [n]).
@@ -167,10 +170,27 @@ class CSVec:
         ests = signs * table[jnp.arange(self.r)[:, None], buckets]  # [r, n]
         return jnp.median(ests, axis=0)
 
+    def estimate_all(self, table: jax.Array) -> jax.Array:
+        """[B, c] median-of-rows estimates for every coordinate
+        (flattened [: d] is the full estimate vector): r inverse
+        rotations + sign correction per chunk, no gathers."""
+        eps = jnp.asarray(self._eps)
+
+        def body(_, xs):
+            off_b, delta_b = xs
+            rows = [self._unrotate(table[j], off_b[j])
+                    for j in range(self.r)]
+            ests = jnp.stack(rows) * eps * delta_b[:, None]     # [r, c]
+            return None, jnp.median(ests, axis=0)
+
+        _, est = jax.lax.scan(
+            body, None,
+            (jnp.asarray(self._offsets).T, jnp.asarray(self._delta).T))
+        return est                                            # [B, c]
+
     def decode_topk(self, table: jax.Array, k: int) -> jax.Array:
         """Dense [d] vector holding the k largest-magnitude estimated
-        coordinates (reference csvec unSketch(k)). Blockwise scan with
-        a running top-k buffer: never materializes all d estimates."""
+        coordinates (reference csvec unSketch(k))."""
         sparse_idx, sparse_vals = self.decode_topk_sparse(table, k)
         dense = jnp.zeros(self.d, jnp.float32)
         return dense.at[sparse_idx].set(sparse_vals, mode="drop")
@@ -182,25 +202,37 @@ class CSVec:
         slots carry index d (out of range; dropped by `mode='drop'`
         scatters downstream)."""
         k = min(k, self.d)
-        chunk = self._chunk
-        n_blocks = -(-self.d // chunk)
+        kc = min(k, self.c)
+        eps = jnp.asarray(self._eps)
 
-        def body(carry, b):
-            best_idx, best_vals = carry
-            start = b * chunk
-            i = start + jnp.arange(chunk, dtype=jnp.int32)
-            est = self.estimate(table, i)
-            est = jnp.where(i < self.d, est, 0.0)
-            cand_idx = jnp.concatenate([best_idx, i])
-            cand_vals = jnp.concatenate([best_vals, est])
-            _, sel = jax.lax.top_k(cand_vals * cand_vals, k)
-            return (cand_idx[sel], cand_vals[sel]), None
+        # blockwise: per chunk keep the top-min(k, c) candidates (a
+        # chunk holds at most c coords, so this preserves exactness),
+        # then one final top-k over the B * kc survivors. Never
+        # materializes all d estimates at once (SURVEY.md §7.3 hard
+        # part #1: d = O(1e8) must stay bounded).
+        def body(_, xs):
+            off_b, delta_b, b = xs
+            rows = [self._unrotate(table[j], off_b[j])
+                    for j in range(self.r)]
+            est = jnp.median(jnp.stack(rows) * eps * delta_b[:, None],
+                             axis=0)                          # [c]
+            i_global = b * self.c + jnp.arange(self.c, dtype=jnp.int32)
+            est = jnp.where(i_global < self.d, est, 0.0)
+            _, sel = jax.lax.top_k(est * est, kc)
+            return None, (i_global[sel], est[sel])
 
-        init = (jnp.full_like(table, self.d, dtype=jnp.int32, shape=(k,)),
-                jnp.zeros_like(table, shape=(k,)))
-        (idx, vals), _ = jax.lax.scan(
-            body, init, jnp.arange(n_blocks, dtype=jnp.int32))
-        return idx, vals
+        _, (cand_idx, cand_vals) = jax.lax.scan(
+            body, None,
+            (jnp.asarray(self._offsets).T, jnp.asarray(self._delta).T,
+             jnp.arange(self.n_chunks, dtype=jnp.int32)))
+        cand_idx = cand_idx.reshape(-1)                       # [B * kc]
+        cand_vals = cand_vals.reshape(-1)
+        _, sel = jax.lax.top_k(cand_vals * cand_vals, k)
+        idx, vals = cand_idx[sel], cand_vals[sel]
+        # slots holding a zero estimate are "unfilled": report index d
+        # so downstream drop-mode scatters ignore them
+        idx = jnp.where(vals == 0.0, self.d, idx)
+        return idx.astype(jnp.int32), vals
 
     # --- norms -----------------------------------------------------------
     def l2estimate(self, table: jax.Array) -> jax.Array:
