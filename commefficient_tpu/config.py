@@ -55,7 +55,7 @@ class Config:
     do_finetune: bool = False
     do_checkpoint: bool = False
     checkpoint_path: str = "./checkpoint"
-    checkpoint_every: int = 0  # rounds between mid-run checkpoints; 0 = end only
+    checkpoint_every: int = 0  # epochs between mid-run checkpoints; 0 = end only
     resume: bool = False
     finetune_path: str = "./finetune"
     finetuned_from: Optional[str] = None
@@ -89,6 +89,10 @@ class Config:
     # unused: there is no process-group rendezvous in a single-program
     # SPMD runtime (reference needed it at fed_aggregator.py:161-164).
     port: int = 5315
+    # run each epoch's rounds as one scanned device program (a TPU-only
+    # capability; the reference's process/queue round-trip per round
+    # cannot be batched this way)
+    scan_rounds: bool = False
     num_clients: Optional[int] = None
     num_workers: int = 1
     device: str = "tpu"
@@ -247,6 +251,8 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--device", type=str, default="tpu")
     p.add_argument("--num_devices", type=int, default=1)
     p.add_argument("--share_ps_gpu", action="store_true")
+    p.add_argument("--scan_rounds", action="store_true",
+                   help="run each epoch as one scanned device program")
     p.add_argument("--iid", action="store_true", dest="do_iid")
     p.add_argument("--train_dataloader_workers", type=int, default=0)
     p.add_argument("--val_dataloader_workers", type=int, default=0)

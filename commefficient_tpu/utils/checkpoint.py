@@ -1,0 +1,96 @@
+"""Checkpoint / resume.
+
+The reference only saves a final `state_dict` (reference:
+CommEfficient/cv_train.py:418-421 via the FedModel.__getattr__ hack at
+fed_aggregator.py:372-376) and HF `save_pretrained` for GPT2
+(fed_aggregator.py:208-211); there is no mid-run resume anywhere
+(SURVEY.md §5). Here checkpointing is a first-class subsystem: the
+full training state — PS weights, server momentum/error state, round
+counter, per-client persistent state, scheduler step — round-trips
+through one .npz file, enabling both the reference's end-of-training
+save and true mid-run resume.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.federated.round import ClientState, ServerState
+
+
+def save_checkpoint(path: str, server: ServerState,
+                    clients: Optional[ClientState] = None,
+                    scheduler_step: int = 0,
+                    include_clients: bool = True) -> str:
+    """Write training state to `path` (.npz appended if absent).
+    Per-client state can be excluded (include_clients=False) to keep
+    files small when clients are stateless (error_type != local and
+    no local momentum)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    arrays = {
+        "ps_weights": np.asarray(server.ps_weights),
+        "Vvelocity": np.asarray(server.Vvelocity),
+        "Verror": np.asarray(server.Verror),
+        "round_idx": np.asarray(server.round_idx),
+        "scheduler_step": np.asarray(scheduler_step),
+    }
+    if include_clients and clients is not None:
+        arrays["client_errors"] = np.asarray(clients.errors)
+        arrays["client_velocities"] = np.asarray(clients.velocities)
+        arrays["client_weights"] = np.asarray(clients.weights)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    return path
+
+
+def load_checkpoint(path: str) -> Tuple[ServerState, Optional[ClientState],
+                                        int]:
+    """Read training state back. Returns (server, clients-or-None,
+    scheduler_step)."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    z = np.load(path)
+    server = ServerState(
+        ps_weights=jnp.asarray(z["ps_weights"]),
+        Vvelocity=jnp.asarray(z["Vvelocity"]),
+        Verror=jnp.asarray(z["Verror"]),
+        round_idx=jnp.asarray(z["round_idx"]),
+    )
+    clients = None
+    if "client_errors" in z:
+        clients = ClientState(
+            errors=jnp.asarray(z["client_errors"]),
+            velocities=jnp.asarray(z["client_velocities"]),
+            weights=jnp.asarray(z["client_weights"]),
+        )
+    return server, clients, int(z["scheduler_step"])
+
+
+def transfer_for_finetune(old_params, new_template):
+    """Head-swap transfer (reference resnet9.py:105-130 + finetune load
+    at cv_train.py:377-384): copy every leaf whose path+shape matches
+    the new model; leaves that differ (e.g. the classifier head for a
+    different class count) keep the new model's fresh initialization.
+    Returns (params, frozen_mask_pytree) where frozen_mask marks the
+    transferred (frozen in the reference) leaves with 1.0."""
+    old_flat = dict(jax.tree_util.tree_flatten_with_path(old_params)[0])
+    new_flat, treedef = jax.tree_util.tree_flatten_with_path(new_template)
+
+    out, frozen = [], []
+    for path, leaf in new_flat:
+        prev = old_flat.get(path)
+        if prev is not None and prev.shape == leaf.shape:
+            out.append(jnp.asarray(prev))
+            frozen.append(jnp.ones((), jnp.float32))
+        else:
+            out.append(leaf)
+            frozen.append(jnp.zeros((), jnp.float32))
+    params = jax.tree_util.tree_unflatten(treedef, out)
+    mask = jax.tree_util.tree_unflatten(treedef, frozen)
+    return params, mask

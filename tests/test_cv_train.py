@@ -1,0 +1,58 @@
+"""Driver smoke tests — the reference's `--test` mode as real CI
+(SURVEY.md §4: the reference's only integration test was a manual
+--test launch on a multi-GPU box)."""
+import os
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.training import cv_train
+
+
+def run_main(tmp_path, *extra):
+    argv = [
+        "--test", "--dataset_name", "CIFAR10",
+        "--dataset_dir", str(tmp_path / "ds"),
+        "--local_momentum", "0.0",
+        "--num_workers", "8", "--local_batch_size", "8",
+        "--num_epochs", "0.05", "--valid_batch_size", "16",
+        "--lr_scale", "0.1",
+        *extra,
+    ]
+    return cv_train.main(argv)
+
+
+def test_smoke_sketch(tmp_path):
+    assert run_main(tmp_path, "--mode", "sketch",
+                    "--error_type", "virtual",
+                    "--virtual_momentum", "0.9")
+
+
+def test_smoke_uncompressed_scan_rounds(tmp_path):
+    assert run_main(tmp_path, "--mode", "uncompressed", "--scan_rounds")
+
+
+def test_checkpoint_and_resume(tmp_path):
+    ck = str(tmp_path / "ck")
+    assert run_main(tmp_path, "--mode", "uncompressed",
+                    "--checkpoint", "--checkpoint_path", ck)
+    assert os.path.exists(os.path.join(ck, "ResNet9.npz"))
+    # resume with a larger budget continues rather than restarting
+    assert run_main(tmp_path, "--mode", "uncompressed", "--resume",
+                    "--checkpoint_path", ck, "--num_epochs", "0.1")
+
+
+def test_finetune_head_swap(tmp_path):
+    ck = str(tmp_path / "ck")
+    assert run_main(tmp_path, "--mode", "uncompressed",
+                    "--checkpoint", "--checkpoint_path", ck)
+    assert cv_train.main([
+        "--test", "--dataset_name", "CIFAR100",
+        "--dataset_dir", str(tmp_path / "ds"),
+        "--local_momentum", "0.0", "--mode", "uncompressed",
+        "--num_workers", "8", "--local_batch_size", "8",
+        "--num_epochs", "0.05", "--valid_batch_size", "16",
+        "--lr_scale", "0.1",
+        "--finetune", "--finetuned_from", "CIFAR10",
+        "--finetune_path", ck,
+    ])
