@@ -1,0 +1,139 @@
+"""Federated EMNIST (LEAF FEMNIST): one client per writer.
+
+Capability parity with the reference's EMNIST layer (reference:
+CommEfficient/data_utils/fed_emnist.py — LEAF per-user JSON parsing
+`read_data` :11-33; concatenation into big arrays + per-client offsets
+to dodge fd limits :40-58; 28x28x1 images, 62 classes). TPU-first
+re-design: everything lands in one memory-mapped .npz (images,
+targets, offsets) — the reference's fd-limit workaround becomes the
+natural storage layout, and fetches are pure numpy slices.
+
+Sources, in order of preference:
+  1. LEAF JSON shards under <dataset_dir>/EMNIST/raw/{train,test}/*.json
+     (the standard LEAF femnist output; keys `users`, `user_data`).
+  2. `synthetic_examples=(num_writers, images_per_writer)` — a
+     deterministic writer-heterogeneous synthetic corpus (per-class
+     stroke template + per-writer style shift + noise) for
+     environments without the dataset (no network egress).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+NUM_CLASSES = 62
+HW = 28
+
+
+def read_leaf_dir(data_dir: str):
+    """Parse every LEAF .json shard in `data_dir` into
+    {user: (images [n, 28, 28, 1] uint8, labels [n] int64)}
+    (reference read_data, fed_emnist.py:11-33; stdlib json instead of
+    orjson, which is not in this environment)."""
+    users = {}
+    for fname in sorted(os.listdir(data_dir)):
+        if not fname.endswith(".json"):
+            continue
+        with open(os.path.join(data_dir, fname)) as f:
+            shard = json.load(f)
+        for user, ud in shard["user_data"].items():
+            x = np.asarray(ud["x"], np.float32).reshape(-1, HW, HW, 1)
+            # LEAF stores white-background floats in [0, 1]
+            x = (x * 255).astype(np.uint8)
+            y = np.asarray(ud["y"], np.int64)
+            users[user] = (x, y)
+    return users
+
+
+def _synthetic_emnist(num_writers: int, per_writer: int, n_val: int,
+                      seed: int):
+    """Writer-heterogeneous synthetic handwriting: class templates +
+    per-writer style shift, mirroring FEMNIST's non-IIDness."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(NUM_CLASSES, HW, HW, 1).astype(np.float32)
+
+    def writer(w_seed, n):
+        wrng = np.random.RandomState(w_seed)
+        style = wrng.randn(HW, HW, 1).astype(np.float32) * 0.1
+        y = wrng.randint(0, NUM_CLASSES, n)
+        x = templates[y] + style + wrng.randn(n, HW, HW, 1).astype(
+            np.float32) * 0.05
+        return (np.clip(x, 0, 1) * 255).astype(np.uint8), y
+
+    train = [writer(seed * 77 + w, per_writer) for w in range(num_writers)]
+    val_x, val_y = writer(seed * 77 - 1, n_val)
+    return train, (val_x, val_y)
+
+
+class FedEMNIST(FedDataset):
+    num_classes = NUM_CLASSES
+
+    def __init__(self, dataset_dir, dataset_name="EMNIST", transform=None,
+                 do_iid=False, num_clients=None, train=True, download=False,
+                 synthetic_examples: Optional[Tuple[int, int]] = None,
+                 seed: int = 0):
+        self._synthetic_examples = synthetic_examples
+        self._seed = seed
+        self._z = {}
+        super().__init__(dataset_dir, dataset_name, transform, do_iid,
+                         num_clients, train, download, seed)
+
+    def _dir(self):
+        return os.path.join(self.dataset_dir, self.dataset_name)
+
+    def _npz_path(self, split: str) -> str:
+        return os.path.join(self._dir(), f"{split}.npz")
+
+    def prepare(self, download: bool = False):
+        raw_train = os.path.join(self._dir(), "raw", "train")
+        raw_test = os.path.join(self._dir(), "raw", "test")
+        if os.path.isdir(raw_train):
+            users = read_leaf_dir(raw_train)
+            train = [users[u] for u in sorted(users)]
+            test_users = (read_leaf_dir(raw_test)
+                          if os.path.isdir(raw_test) else {})
+            if test_users:
+                vx = np.concatenate([x for x, _ in test_users.values()])
+                vy = np.concatenate([y for _, y in test_users.values()])
+            else:
+                vx = np.zeros((0, HW, HW, 1), np.uint8)
+                vy = np.zeros((0,), np.int64)
+        elif self._synthetic_examples is not None:
+            writers, per_writer = self._synthetic_examples
+            train, (vx, vy) = _synthetic_emnist(
+                writers, per_writer, n_val=max(per_writer * 4, 64),
+                seed=self._seed)
+        else:
+            raise FileNotFoundError(
+                f"No LEAF shards under {raw_train} and no network egress; "
+                f"pass synthetic_examples=(num_writers, images_per_writer)")
+
+        os.makedirs(self._dir(), exist_ok=True)
+        images = np.concatenate([x for x, _ in train])
+        targets = np.concatenate([y for _, y in train])
+        offsets = np.concatenate(
+            [[0], np.cumsum([len(y) for _, y in train])])
+        np.savez(self._npz_path("train"), images=images, targets=targets,
+                 offsets=offsets)
+        np.savez(self._npz_path("val"), images=vx, labels=vy)
+        self.write_stats([len(y) for _, y in train], len(vy))
+
+    def _load(self, split: str):
+        if split not in self._z:
+            self._z[split] = dict(np.load(self._npz_path(split)))
+        return self._z[split]
+
+    def _get_train_batch(self, nat_client_id: int, idxs: np.ndarray):
+        z = self._load("train")
+        start = z["offsets"][nat_client_id]
+        sel = start + np.asarray(idxs)
+        return z["images"][sel], z["targets"][sel]
+
+    def _get_val_batch(self, idxs: np.ndarray):
+        z = self._load("val")
+        return z["images"][idxs], z["labels"][idxs]
