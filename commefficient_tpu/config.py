@@ -91,8 +91,12 @@ class Config:
     port: int = 5315
     # run each epoch's rounds as one scanned device program (a TPU-only
     # capability; the reference's process/queue round-trip per round
-    # cannot be batched this way)
+    # cannot be batched this way). scan_span bounds the staged
+    # [N, W, B, ...] device arrays by flushing every `scan_span` rounds
+    # (0 = whole epoch in one program; set a span at ImageNet scale —
+    # staging memory is span * num_workers * B * example_bytes).
     scan_rounds: bool = False
+    scan_span: int = 0
     num_clients: Optional[int] = None
     num_workers: int = 1
     device: str = "tpu"
@@ -253,6 +257,8 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--share_ps_gpu", action="store_true")
     p.add_argument("--scan_rounds", action="store_true",
                    help="run each epoch as one scanned device program")
+    p.add_argument("--scan_span", type=int, default=0,
+                   help="flush scanned rounds every N rounds (0=epoch)")
     p.add_argument("--iid", action="store_true", dest="do_iid")
     p.add_argument("--train_dataloader_workers", type=int, default=0)
     p.add_argument("--val_dataloader_workers", type=int, default=0)

@@ -61,10 +61,19 @@ def _popcount(words: np.ndarray) -> int:
 
 
 class CommAccountant:
-    def __init__(self, cfg: Config, num_clients: int):
+    def __init__(self, cfg: Config, num_clients: int,
+                 frozen_count: int = 0):
         self.cfg = cfg
         self.num_clients = num_clients
         self.n_words = -(-cfg.grad_size // 32)
+        # finetune-frozen coordinates transmit nothing in the dense-
+        # upload modes (the reference's requires_grad=False params are
+        # not in the flat vector at all); sketch tables and the top-k
+        # budget keep their full size regardless
+        self.upload_floats = cfg.upload_floats
+        if frozen_count and cfg.mode in ("uncompressed", "true_topk",
+                                         "fedavg"):
+            self.upload_floats = cfg.grad_size - frozen_count
         # cheap path applies when every client re-downloads everything
         # changed since init (reference fed_aggregator.py:171-177)
         self.cheap = (cfg.num_epochs <= 1 and cfg.local_batch_size == -1)
@@ -114,5 +123,46 @@ class CommAccountant:
             self.stale += 1
 
         upload = np.zeros(self.num_clients)
-        upload[participating] = 4.0 * self.cfg.upload_floats
+        upload[participating] = 4.0 * self.upload_floats
         return download, upload
+
+    def advance_round(self, participating: np.ndarray,
+                      prev_changed_words: Optional[np.ndarray]) -> None:
+        """Advance the accountant's state for a round whose byte totals
+        the caller doesn't want (FedModel.run_rounds(account=False)):
+        the change deque and staleness counters move exactly as in
+        record_round, only the popcount work is skipped. Without this,
+        the first accounted round after an unaccounted span would
+        misattribute download bytes."""
+        participating = np.asarray(participating)
+        if self.cheap:
+            if prev_changed_words is not None:
+                self.updated_since_init |= np.asarray(prev_changed_words)
+        else:
+            if prev_changed_words is not None:
+                self.changes.append(np.asarray(prev_changed_words))
+            self.stale[participating] = 0
+            self.stale += 1
+
+    # -- checkpoint round-trip (utils.checkpoint serializes this so
+    #    resumed runs keep cumulative comm totals correct) -------------
+    def state_dict(self) -> dict:
+        state = {}
+        if self.cheap:
+            state["updated_since_init"] = self.updated_since_init.copy()
+        else:
+            state["stale"] = self.stale.copy()
+            state["changes"] = (np.stack(list(self.changes))
+                                if len(self.changes)
+                                else np.zeros((0, self.n_words), np.uint32))
+        return state
+
+    def load_state_dict(self, state: dict) -> None:
+        if self.cheap:
+            self.updated_since_init = np.asarray(
+                state["updated_since_init"], np.uint32)
+        else:
+            self.stale = np.asarray(state["stale"], np.int64)
+            self.changes.clear()
+            for row in np.asarray(state["changes"], np.uint32):
+                self.changes.append(row)

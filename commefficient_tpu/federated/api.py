@@ -74,18 +74,28 @@ class FedModel:
         self._loss_train = loss_train
         self._loss_val = loss_val if loss_val is not None else loss_train
 
-        self._train_round, self._eval_batch = fround.make_round_fns(
-            self._loss_train, self.unravel, cfg, self.mesh)
-        if loss_val is not None:
-            cfg_val = cfg
-            _, self._eval_batch = fround.make_round_fns(
-                self._loss_val, self.unravel, cfg_val, self.mesh)
+        # frozen-coordinate gradient mask: exactly-zero lr scales mark
+        # finetune-frozen leaves; zero their gradients at the source so
+        # they consume no compression budget (reference freezing is
+        # requires_grad=False, which removes them entirely)
+        grad_mask = None
+        if lr_scale_vec is not None and np.any(np.asarray(lr_scale_vec) == 0):
+            grad_mask = (np.asarray(lr_scale_vec) != 0).astype(np.float32)
+
+        self._train_round = fround.make_train_fn(
+            self._loss_train, self.unravel, cfg, self.mesh,
+            grad_mask=grad_mask)
+        self._eval_batch = fround.make_eval_fn(
+            self._loss_val, self.unravel, cfg, self.mesh)
 
         self.server = fround.init_server_state(cfg, vec)
         self.clients = fround.init_client_state(
             cfg, self.num_clients, vec, mesh=self.mesh)
 
-        self.accountant = CommAccountant(cfg, self.num_clients)
+        self.accountant = CommAccountant(
+            cfg, self.num_clients,
+            frozen_count=(0 if grad_mask is None
+                          else int((grad_mask == 0).sum())))
         self._prev_change_words: Optional[np.ndarray] = None
         self._pack_bits = jax.jit(pack_change_bits)
         self._key = jax.random.PRNGKey(cfg.seed)
@@ -123,8 +133,10 @@ class FedModel:
         if self._optimizer is None:
             raise RuntimeError("attach a FedOptimizer before training")
         lr = self._optimizer.param_groups[0]["lr"]
-        if self.cfg.mode == "fedavg":
-            return lr  # clients apply it locally; server uses lr=1
+        # per-parameter LR scaling (finetune freezing / Fixup param
+        # groups) applies in EVERY mode: for fedavg the [D] vector
+        # reaches the client's local SGD steps (fedavg_step broadcasts
+        # it elementwise), while the server update stays at lr=1.
         if self.lr_scale_vec is not None:
             return lr * self.lr_scale_vec
         return lr
@@ -156,13 +168,15 @@ class FedModel:
         [N, W, B, ...]; mask: [N, W, B]; lrs: [N].
 
         Returns (losses [N, W], metrics [N, W]..., download, upload)
-        with download/upload summed over the span (zeros when
-        account=False, which also skips the bitset transfer)."""
-        prev_weights = self.server.ps_weights
+        with download/upload summed over the span. account=False
+        returns zeros and skips the per-round popcount work, but the
+        [N, D/32] bitset transfer and staleness bookkeeping still
+        happen so later accounted rounds stay correct."""
         lrs = jnp.asarray(lrs)
-        if self.lr_scale_vec is not None and self.cfg.mode != "fedavg":
-            # per-parameter LR scaling (Fixup param groups) — same
-            # routing _lr() applies on the single-round path
+        if self.lr_scale_vec is not None:
+            # per-parameter LR scaling — same routing _lr() applies on
+            # the single-round path (incl. fedavg: the vector reaches
+            # the clients' local steps)
             lrs = lrs[:, None] * self.lr_scale_vec[None, :]
         self.server, self.clients, metrics, bits = (
             self._train_round.train_rounds(
@@ -174,18 +188,21 @@ class FedModel:
 
         download = np.zeros(self.num_clients)
         upload = np.zeros(self.num_clients)
-        if account:
-            bits_host = np.asarray(bits)
-            ids_host = np.asarray(client_ids)
-            for n in range(ids_host.shape[0]):
+        bits_host = np.asarray(bits)
+        ids_host = np.asarray(client_ids)
+        for n in range(ids_host.shape[0]):
+            if account:
                 d, u = self.accountant.record_round(
                     ids_host[n], self._prev_change_words)
-                self._prev_change_words = bits_host[n]
                 download += d
                 upload += u
-        else:
-            self._prev_change_words = np.asarray(
-                self._pack_bits(self.server.ps_weights - prev_weights))
+            else:
+                # keep the change deque and staleness counters in sync
+                # (skipping only the popcount work) so a later accounted
+                # round doesn't misattribute downloads across the gap
+                self.accountant.advance_round(
+                    ids_host[n], self._prev_change_words)
+            self._prev_change_words = bits_host[n]
 
         losses = np.asarray(metrics.losses)
         mets = [np.asarray(m) for m in metrics.metrics]

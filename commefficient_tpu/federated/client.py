@@ -85,7 +85,8 @@ def _reshape_microbatches(tree, mask, n_mb: int, mb: int):
 
 def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
                  cfg: Config, key: Optional[jax.Array] = None,
-                 compute_grad: bool = True):
+                 compute_grad: bool = True,
+                 grad_mask: Optional[jax.Array] = None):
     """Microbatched forward(/backward) over one client's padded batch
     (reference forward_grad, fed_worker.py:249-335).
 
@@ -143,6 +144,15 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
     # num_iters — fed_worker.py:286-292.)
     grad = grad_sum / denom
 
+    # frozen-coordinate masking FIRST: frozen coordinates contribute
+    # nothing — no gradient, no weight decay, no share of any clipping
+    # norm, no compression budget. The reference gets all of this for
+    # free because requires_grad=False params never enter the flat
+    # vector; here they stay in the vector, so every term below must
+    # exclude them explicitly.
+    if grad_mask is not None:
+        grad = grad * grad_mask
+
     # gradient clipping for non-sketch modes (reference
     # fed_worker.py:290-292; unscaled here per the note above)
     if cfg.max_grad_norm is not None and cfg.mode != "sketch":
@@ -151,7 +161,10 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
     # weight decay folded into the gradient, divided by num_workers so
     # the summed transmission applies it once (reference utils.py:254-259)
     if cfg.weight_decay != 0:
-        grad = grad + (cfg.weight_decay / cfg.num_workers) * weights
+        wd_term = (cfg.weight_decay / cfg.num_workers) * weights
+        if grad_mask is not None:
+            wd_term = wd_term * grad_mask
+        grad = grad + wd_term
 
     # differential privacy at the worker (reference fed_worker.py:304-309)
     if cfg.do_dp:
@@ -159,6 +172,8 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
         if cfg.dp_mode == "worker":
             grad = grad + dp_noise(key, grad.shape, cfg.noise_multiplier,
                                    scale=float(np.sqrt(cfg.num_workers)))
+        if grad_mask is not None:
+            grad = grad * grad_mask  # DP noise lands only on live coords
 
     # per-mode compression (reference fed_worker.py:311-335)
     if cfg.mode == "sketch":
@@ -185,11 +200,12 @@ def _eval_loss(flat_grad_fn, weights, b, m):
 
 
 def local_step(flat_grad_fn, weights, batch, mask, error, velocity,
-               cfg: Config, key=None) -> ClientResult:
+               cfg: Config, key=None,
+               grad_mask: Optional[jax.Array] = None) -> ClientResult:
     """One client's single local step + compression bookkeeping
     (reference local_step, fed_worker.py:184-230)."""
     g, loss, metrics, count = forward_grad(
-        flat_grad_fn, weights, batch, mask, cfg, key)
+        flat_grad_fn, weights, batch, mask, cfg, key, grad_mask=grad_mask)
 
     # transmit sums over examples; server divides by the global batch
     # size (reference fed_worker.py:190)
@@ -216,7 +232,8 @@ def local_step(flat_grad_fn, weights, batch, mask, error, velocity,
 
 
 def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
-                lr, key=None) -> ClientResult:
+                lr, key=None,
+                grad_mask: Optional[jax.Array] = None) -> ClientResult:
     """FedAvg: full local SGD over the client's dataset, transmitting
     the dataset-size-weighted weight delta (reference worker_loop
     fedavg branch, fed_worker.py:61-113).
@@ -224,6 +241,12 @@ def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
     `batch` holds the client's entire local dataset padded to a static
     size; it is split into fedavg_batch_size local batches and scanned
     num_fedavg_epochs times with per-step lr decay fedavg_lr_decay**step.
+
+    `lr` may be a scalar or a per-parameter [D] vector (finetune
+    freezing / Fixup param-group LRs applied to the LOCAL steps, since
+    fedavg's server update runs at lr=1); `grad_mask` zeroes frozen
+    coordinates' local gradients so they neither move nor accrue
+    weight decay.
     """
     B = mask.shape[0]
     inner = B if cfg.fedavg_batch_size == -1 else min(cfg.fedavg_batch_size, B)
@@ -247,6 +270,8 @@ def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
         # masked-mean gradient, but weight decay must still be added
         if cfg.weight_decay != 0:
             grad = grad + (cfg.weight_decay / cfg.num_workers) * w
+        if grad_mask is not None:
+            grad = grad * grad_mask
         decay = cfg.fedavg_lr_decay ** step
         w = w - grad * lr * decay
         return (w, step + 1.0), (loss, metrics)

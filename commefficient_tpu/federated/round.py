@@ -117,15 +117,33 @@ def _has_velocities(cfg): return cfg.local_momentum > 0
 
 
 def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
-                   cfg: Config, mesh: Mesh):
-    """Build the jitted train-round and eval functions.
+                   cfg: Config, mesh: Mesh, grad_mask=None):
+    """Build the jitted (train-round, eval) pair. Thin wrapper over the
+    split factories below, kept for callers that want both from one
+    loss function."""
+    return (make_train_fn(loss_fn, unravel, cfg, mesh, grad_mask),
+            make_eval_fn(loss_fn, unravel, cfg, mesh))
+
+
+def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
+                  cfg: Config, mesh: Mesh, grad_mask=None):
+    """Build the jitted train-round function.
 
     loss_fn(params_pytree, batch_tuple, mask) -> (loss, metrics_tuple)
     is the workload callback — the API contract preserved from the
     reference (SURVEY.md §3.5): FedModel(model, compute_loss, args).
+
+    grad_mask: optional [D] f32 mask multiplied into every client
+    gradient *before* compression — frozen (finetune-transferred)
+    coordinates are zeroed at the source, so they consume no k-budget
+    or sketch capacity. This matches the reference's freezing
+    semantics, where requires_grad=False params never produce
+    gradients at all (cv_train.py:377-384).
     """
     cfg.validate()
     flat_grad = fclient.make_flat_grad_fn(loss_fn, unravel)
+    if grad_mask is not None:
+        grad_mask = jnp.asarray(grad_mask, jnp.float32)
     n_shards = mesh.devices.size
 
     # ---------------- per-shard client phase ----------------------------
@@ -153,10 +171,12 @@ def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
 
             if cfg.mode == "fedavg":
                 res = fclient.fedavg_step(
-                    flat_grad, weights, cdata, cmask, cfg, lr, key)
+                    flat_grad, weights, cdata, cmask, cfg, lr, key,
+                    grad_mask=grad_mask)
             else:
                 res = fclient.local_step(
-                    flat_grad, weights, cdata, cmask, err, vel, cfg, key)
+                    flat_grad, weights, cdata, cmask, err, vel, cfg, key,
+                    grad_mask=grad_mask)
             new_w = (weights if cfg.do_topk_down
                      else jnp.zeros_like(cmask, shape=()))
             return res, new_w
@@ -275,7 +295,25 @@ def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
             body, (server, clients), (batches, lrs))
         return server, clients, metrics, bits
 
-    # ---------------- eval ----------------------------------------------
+    class TrainRound:
+        """Callable single-round step; `.train_rounds` runs a whole
+        scanned span of rounds in one device program."""
+
+        def __call__(self, server, clients, batch, lr, key):
+            return _train_round_jit(server, clients, batch, lr, key)
+
+    handle = TrainRound()
+    handle.train_rounds = train_rounds
+    return handle
+
+
+def make_eval_fn(loss_fn: fclient.LossFn, unravel: Callable,
+                 cfg: Config, mesh: Mesh):
+    """Build the jitted eval function — separate from the train factory
+    so a distinct val loss (GPT2's nll/acc/ppl metrics,
+    gpt2_train.py:242-253) never builds a throwaway train round."""
+    flat_grad = fclient.make_flat_grad_fn(loss_fn, unravel)
+
     def shard_eval(ps_weights, data, mask):
         def one_shard(b, m):
             _, loss, metrics, count = fclient.forward_grad(
@@ -296,13 +334,4 @@ def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
         reference _call_val (fed_aggregator.py:337-364)."""
         return shard_eval_mapped(ps_weights, data, mask)
 
-    class TrainRound:
-        """Callable single-round step; `.train_rounds` runs a whole
-        scanned span of rounds in one device program."""
-
-        def __call__(self, server, clients, batch, lr, key):
-            return _train_round_jit(server, clients, batch, lr, key)
-
-    handle = TrainRound()
-    handle.train_rounds = train_rounds
-    return handle, eval_batch
+    return eval_batch

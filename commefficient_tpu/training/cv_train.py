@@ -143,25 +143,41 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
         up = np.zeros(model.num_clients)
 
         if cfg.scan_rounds:
-            # one scanned device program for the whole epoch
+            # scanned device programs, flushed every --scan_span rounds
+            # to bound the staged [N, W, B, ...] arrays (0 = whole epoch)
+            span_cap = cfg.scan_span if cfg.scan_span > 0 else epoch_rounds
+            taken = 0
             ids, datas, masks, lrs = [], [], [], []
+
+            def flush():
+                loss_nw, acc_nw, d, u = model.run_rounds(
+                    np.stack(ids),
+                    tuple(np.stack([dd[i] for dd in datas])
+                          for i in range(len(datas[0]))),
+                    np.stack(masks), np.asarray(lrs))
+                losses.extend(loss_nw.mean(axis=1))
+                accs.extend(acc_nw.mean(axis=1))
+                return d, u
+
             for client_ids, data, mask in train_loader.epoch():
-                if len(ids) == epoch_rounds:
+                if taken == epoch_rounds:
                     break
                 lr_scheduler.step()
                 lrs.append(opt.param_groups[0]["lr"])
                 ids.append(client_ids)
                 datas.append(data)
                 masks.append(mask)
-            out = model.run_rounds(
-                np.stack(ids),
-                tuple(np.stack([d[i] for d in datas])
-                      for i in range(len(datas[0]))),
-                np.stack(masks), np.asarray(lrs))
-            loss_nw, acc_nw, down, up = out
-            losses = list(loss_nw.mean(axis=1))
-            accs = list(acc_nw.mean(axis=1))
-            rounds_done += len(ids)
+                taken += 1
+                if len(ids) == span_cap:
+                    d, u = flush()
+                    down += d
+                    up += u
+                    ids, datas, masks, lrs = [], [], [], []
+            if ids:
+                d, u = flush()
+                down += d
+                up += u
+            rounds_done += taken
         else:
             for client_ids, data, mask in train_loader.epoch():
                 if rounds_done >= total_rounds:
@@ -215,7 +231,9 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
         if cfg.checkpoint_every and epoch % cfg.checkpoint_every == 0:
             path = _ckpt_path(cfg)
             save_checkpoint(path, model.server, model.clients,
-                            scheduler_step=lr_scheduler.step_count)
+                            scheduler_step=lr_scheduler.step_count,
+                            accountant=model.accountant,
+                            prev_change_words=model._prev_change_words)
             print(f"checkpointed to {path}")
 
     return True
@@ -266,8 +284,8 @@ def main(argv=None) -> bool:
     # groups, cv_train.py:377-384)
     lr_scale_vec = None
     if cfg.do_finetune:
-        old_server, _, _ = load_checkpoint(
-            os.path.join(cfg.finetune_path, cfg.model))
+        old_server = load_checkpoint(
+            os.path.join(cfg.finetune_path, cfg.model)).server
         # rebuild the OLD model's param template to unflatten into
         old_cfg_classes = num_classes_of_dataset(cfg.finetuned_from)
         old_module = models.build_model(
@@ -293,12 +311,17 @@ def main(argv=None) -> bool:
     opt = FedOptimizer(model)
 
     if cfg.resume and os.path.exists(_ckpt_path(cfg) + ".npz"):
-        server, clients, sched_step = load_checkpoint(_ckpt_path(cfg))
-        model.server = server
-        if clients is not None:
-            model.clients = clients
+        ckpt = load_checkpoint(_ckpt_path(cfg))
+        model.server = ckpt.server
+        sched_step = ckpt.scheduler_step
+        if ckpt.clients is not None:
+            model.clients = ckpt.clients
+        if ckpt.accountant_state:
+            model.accountant.load_state_dict(ckpt.accountant_state)
+        if ckpt.prev_change_words is not None:
+            model._prev_change_words = ckpt.prev_change_words
         print(f"resumed from {_ckpt_path(cfg)} at round "
-              f"{int(server.round_idx)}")
+              f"{int(ckpt.server.round_idx)}")
     else:
         sched_step = 0
 
@@ -320,7 +343,9 @@ def main(argv=None) -> bool:
 
     if cfg.do_checkpoint:
         path = save_checkpoint(_ckpt_path(cfg), model.server, model.clients,
-                               scheduler_step=lr_scheduler.step_count)
+                               scheduler_step=lr_scheduler.step_count,
+                               accountant=model.accountant,
+                               prev_change_words=model._prev_change_words)
         print(f"saved checkpoint to {path}")
     return ok
 

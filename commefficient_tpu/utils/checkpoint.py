@@ -13,7 +13,7 @@ save and true mid-run resume.
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -22,14 +22,28 @@ import numpy as np
 from commefficient_tpu.federated.round import ClientState, ServerState
 
 
+class Checkpoint(NamedTuple):
+    """Loaded training state; accounting state rides along so resumed
+    runs keep cumulative comm totals correct."""
+    server: ServerState
+    clients: Optional[ClientState]
+    scheduler_step: int
+    accountant_state: Optional[dict] = None
+    prev_change_words: Optional[np.ndarray] = None
+
+
 def save_checkpoint(path: str, server: ServerState,
                     clients: Optional[ClientState] = None,
                     scheduler_step: int = 0,
-                    include_clients: bool = True) -> str:
+                    include_clients: bool = True,
+                    accountant=None,
+                    prev_change_words: Optional[np.ndarray] = None) -> str:
     """Write training state to `path` (.npz appended if absent).
     Per-client state can be excluded (include_clients=False) to keep
     files small when clients are stateless (error_type != local and
-    no local momentum)."""
+    no local momentum). Pass the FedModel's CommAccountant (and its
+    _prev_change_words bitset) so resumed runs continue download
+    accounting instead of restarting from 'round 1 is free'."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if not path.endswith(".npz"):
         path = path + ".npz"
@@ -44,15 +58,18 @@ def save_checkpoint(path: str, server: ServerState,
         arrays["client_errors"] = np.asarray(clients.errors)
         arrays["client_velocities"] = np.asarray(clients.velocities)
         arrays["client_weights"] = np.asarray(clients.weights)
+    if accountant is not None:
+        for k, v in accountant.state_dict().items():
+            arrays[f"acct_{k}"] = v
+    if prev_change_words is not None:
+        arrays["acct_prev_change_words"] = np.asarray(prev_change_words)
     with open(path, "wb") as f:
         np.savez(f, **arrays)
     return path
 
 
-def load_checkpoint(path: str) -> Tuple[ServerState, Optional[ClientState],
-                                        int]:
-    """Read training state back. Returns (server, clients-or-None,
-    scheduler_step)."""
+def load_checkpoint(path: str) -> Checkpoint:
+    """Read training state back."""
     if not path.endswith(".npz"):
         path = path + ".npz"
     z = np.load(path)
@@ -69,7 +86,12 @@ def load_checkpoint(path: str) -> Tuple[ServerState, Optional[ClientState],
             velocities=jnp.asarray(z["client_velocities"]),
             weights=jnp.asarray(z["client_weights"]),
         )
-    return server, clients, int(z["scheduler_step"])
+    acct = {k[len("acct_"):]: z[k] for k in z.files
+            if k.startswith("acct_") and k != "acct_prev_change_words"}
+    prev = (z["acct_prev_change_words"]
+            if "acct_prev_change_words" in z.files else None)
+    return Checkpoint(server, clients, int(z["scheduler_step"]),
+                      acct or None, prev)
 
 
 def transfer_for_finetune(old_params, new_template):

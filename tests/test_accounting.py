@@ -91,3 +91,56 @@ def test_staleness_clamped_to_deque():
     cfg = cfg_for(num_workers=2)
     acct = CommAccountant(cfg, num_clients=4)  # maxlen = 10/(2/4) = 20
     assert acct.changes.maxlen == 20
+
+
+def test_advance_round_keeps_counters_consistent():
+    """account=False spans (advance_round) must leave the accountant in
+    the same state as fully-recorded rounds (ADVICE round-1 #3)."""
+    c1 = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.array([1, 2])].set(1.0)))
+    c2 = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.array([3])].set(1.0)))
+
+    full = CommAccountant(cfg_for(num_workers=2), num_clients=4)
+    full.record_round(np.array([0, 1]), None)
+    full.record_round(np.array([0, 2]), c1)
+    down_full, _ = full.record_round(np.array([1]), c2)
+
+    mixed = CommAccountant(cfg_for(num_workers=2), num_clients=4)
+    mixed.advance_round(np.array([0, 1]), None)
+    mixed.advance_round(np.array([0, 2]), c1)
+    down_mixed, _ = mixed.record_round(np.array([1]), c2)
+
+    np.testing.assert_allclose(down_mixed, down_full)
+
+
+def test_accountant_state_roundtrip():
+    """state_dict/load_state_dict round-trips mid-run accounting state
+    (checkpointed by utils.checkpoint; ADVICE round-1 #4)."""
+    c1 = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.array([1, 2])].set(1.0)))
+    c2 = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.array([3, 10, 11])].set(1.0)))
+
+    a = CommAccountant(cfg_for(num_workers=2), num_clients=4)
+    a.record_round(np.array([0, 1]), None)
+    a.record_round(np.array([0, 2]), c1)
+
+    b = CommAccountant(cfg_for(num_workers=2), num_clients=4)
+    b.load_state_dict(a.state_dict())
+    down_a, _ = a.record_round(np.array([1]), c2)
+    down_b, _ = b.record_round(np.array([1]), c2)
+    np.testing.assert_allclose(down_b, down_a)
+    assert down_a[1] == 4.0 * 5  # {1,2} | {3,10,11}
+
+    # cheap path too
+    cheap_cfg = cfg_for(num_epochs=1.0, local_batch_size=-1,
+                        mode="fedavg", error_type="none")
+    ca = CommAccountant(cheap_cfg, num_clients=4)
+    ca.record_round(np.array([0]), None)
+    ca.record_round(np.array([1]), c1)
+    cb = CommAccountant(cheap_cfg, num_clients=4)
+    cb.load_state_dict(ca.state_dict())
+    da, _ = ca.record_round(np.array([2]), c2)
+    db, _ = cb.record_round(np.array([2]), c2)
+    np.testing.assert_allclose(db, da)
