@@ -6,4 +6,7 @@ from commefficient_tpu.data.loader import FedLoader, FedValLoader  # noqa: F401
 from commefficient_tpu.data.cifar import FedCIFAR10, FedCIFAR100  # noqa: F401
 from commefficient_tpu.data.emnist import FedEMNIST  # noqa: F401
 from commefficient_tpu.data.imagenet import FedImageNet  # noqa: F401
+from commefficient_tpu.data.persona import (  # noqa: F401
+    FedPERSONA, HashTokenizer, make_tokenizer,
+)
 from commefficient_tpu.data import transforms  # noqa: F401

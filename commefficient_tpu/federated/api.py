@@ -68,7 +68,14 @@ class FedModel:
         cfg = cfg.replace(grad_size=int(vec.shape[0])).validate()
         self.cfg = cfg
 
-        self.mesh = mesh if mesh is not None else make_client_mesh()
+        if mesh is None:
+            # widest mesh that divides num_workers (round_step shards
+            # the participating clients evenly across the mesh)
+            n = min(len(jax.devices()), max(cfg.num_workers, 1))
+            while cfg.num_workers % n:
+                n -= 1
+            mesh = make_client_mesh(n)
+        self.mesh = mesh
         self.num_clients = cfg.resolved_num_clients(num_clients)
 
         self._loss_train = loss_train

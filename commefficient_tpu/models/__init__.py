@@ -18,6 +18,9 @@ from commefficient_tpu.models.fixup_resnet import (  # noqa: F401
 )
 from commefficient_tpu.models import resnets
 from commefficient_tpu.models.resnets import ResNet  # noqa: F401
+from commefficient_tpu.models.gpt2 import (  # noqa: F401
+    GPT2Config, GPT2DoubleHeads, build_gpt2,
+)
 
 _REGISTRY: Dict[str, Callable] = {
     "ResNet9": ResNet9,

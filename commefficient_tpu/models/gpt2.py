@@ -1,0 +1,297 @@
+"""GPT-2 with double heads (LM + multiple-choice), in Flax.
+
+The reference imports `GPT2DoubleHeadsModel` from pytorch_transformers
+(reference: CommEfficient/gpt2_train.py:4-6,262-273) — the model that
+scores PersonaChat candidates with a language-modeling head and a
+multiple-choice head at once. Here the architecture is built natively:
+
+  * pre-LN transformer blocks with a fused QKV projection — one big
+    [E, 3E] matmul per block keeps the MXU busy instead of three
+    skinny ones;
+  * the candidate axis is folded into the batch axis before the
+    transformer ([B, C, L] -> [B*C, L]) so every matmul sees the full
+    batch;
+  * the LM head is weight-tied to the token embedding via
+    `nn.Embed.attend` (no duplicate [V, E] parameter — 38M floats at
+    GPT2-small scale);
+  * causal masking is a static lower-triangular bias added pre-softmax
+    (no dynamic shapes, jit-stable);
+  * `resize_token_embeddings` is a pure function returning new params
+    (the reference mutates the torch module in place,
+    gpt2_train.py:101-112).
+
+Pretrained GPT-2 weights can be imported from a local HuggingFace
+`transformers` PyTorch checkpoint via `params_from_hf_state_dict`
+(no network access is assumed — random init is the fallback, matching
+a from-scratch federated run).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+
+    def replace(self, **kw) -> "GPT2Config":
+        return dataclasses.replace(self, **kw)
+
+
+# GPT2-family presets (model_checkpoint flag values, reference
+# gpt2_train.py:262-273 resolves "gpt2"/"gpt2-medium"/... the same way)
+PRESETS = {
+    "gpt2": GPT2Config(),
+    "gpt2-medium": GPT2Config(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-large": GPT2Config(n_embd=1280, n_layer=36, n_head=20),
+    "gpt2-xl": GPT2Config(n_embd=1600, n_layer=48, n_head=25),
+}
+
+
+def _dense(features, cfg, name):
+    return nn.Dense(
+        features, name=name,
+        kernel_init=nn.initializers.normal(cfg.initializer_range))
+
+
+class SelfAttention(nn.Module):
+    """Causal multi-head self-attention with a fused QKV projection."""
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        B, L, E = h.shape
+        H = cfg.n_head
+        hd = E // H
+
+        qkv = _dense(3 * E, cfg, "c_attn")(h)            # [B, L, 3E]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(x):  # [B, L, E] -> [B, H, L, hd]
+            return x.reshape(B, L, H, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        # attention logits in f32 regardless of activation dtype
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                         preferred_element_type=jnp.float32)
+        att = att / jnp.sqrt(jnp.float32(hd))
+        causal = jnp.tril(jnp.ones((L, L), bool))
+        att = jnp.where(causal[None, None], att, jnp.float32(-1e9))
+        att = jax.nn.softmax(att, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, L, E)
+        return _dense(E, cfg, "c_proj")(out)
+
+
+class MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, h):
+        E = self.cfg.n_embd
+        h = _dense(4 * E, self.cfg, "c_fc")(h)
+        h = nn.gelu(h, approximate=True)
+        return _dense(E, self.cfg, "c_proj")(h)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block (GPT-2 ordering)."""
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, h):
+        eps = self.cfg.layer_norm_epsilon
+        h = h + SelfAttention(self.cfg, name="attn")(
+            nn.LayerNorm(epsilon=eps, name="ln_1")(h))
+        h = h + MLP(self.cfg, name="mlp")(
+            nn.LayerNorm(epsilon=eps, name="ln_2")(h))
+        return h
+
+
+class GPT2Transformer(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.cfg
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte",
+                       embedding_init=nn.initializers.normal(
+                           cfg.initializer_range))
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, name="wpe",
+                       embedding_init=nn.initializers.normal(
+                           cfg.initializer_range))
+        L = input_ids.shape[-1]
+        h = wte(input_ids) + wpe(jnp.arange(L))
+        if token_type_ids is not None:
+            # GPT-2 looks token types up in the SAME token embedding
+            # (they are ordinary special-token ids)
+            h = h + wte(token_type_ids)
+        for i in range(cfg.n_layer):
+            h = Block(cfg, name=f"h_{i}")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(h)
+        # weight-tied LM logits
+        lm_logits = wte.attend(h)
+        return h, lm_logits
+
+
+class GPT2DoubleHeads(nn.Module):
+    """LM head + multiple-choice head over candidate sequences.
+
+    __call__(input_ids [..., C, L], token_type_ids [..., C, L],
+             mc_token_ids [..., C]) ->
+        (lm_logits [..., C, L, V], mc_logits [..., C])
+
+    The MC head reads the hidden state at each candidate's
+    `mc_token_ids` position (the last real token) and projects to one
+    scalar per candidate — the reference's SequenceSummary head.
+    """
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, mc_token_ids=None):
+        cfg = self.cfg
+        lead = input_ids.shape[:-1]      # [..., C]
+        L = input_ids.shape[-1]
+        flat_ids = input_ids.reshape(-1, L)
+        flat_tt = (token_type_ids.reshape(-1, L)
+                   if token_type_ids is not None else None)
+
+        h, lm_logits = GPT2Transformer(cfg, name="transformer")(
+            flat_ids, flat_tt)
+
+        if mc_token_ids is None:
+            mc_pos = jnp.full((h.shape[0],), L - 1, jnp.int32)
+        else:
+            mc_pos = mc_token_ids.reshape(-1).astype(jnp.int32)
+        summary = jnp.take_along_axis(
+            h, mc_pos[:, None, None], axis=1)[:, 0]       # [N, E]
+        mc_logits = _dense(1, cfg, "mc_head")(summary)[:, 0]
+
+        # reshape by the logits' own vocab axis (it can exceed
+        # cfg.vocab_size after resize_token_embeddings)
+        return (lm_logits.reshape(lead + (L, lm_logits.shape[-1])),
+                mc_logits.reshape(lead))
+
+
+def build_gpt2(model_checkpoint: str = "gpt2",
+               **overrides) -> GPT2DoubleHeads:
+    """Resolve a GPT2 preset by flag name (reference resolves the HF
+    checkpoint string the same way, gpt2_train.py:262-273)."""
+    cfg = PRESETS.get(model_checkpoint, PRESETS["gpt2"])
+    if overrides:
+        cfg = cfg.replace(**overrides)
+    return GPT2DoubleHeads(cfg)
+
+
+def resize_token_embeddings(params, new_vocab_size: int,
+                            key: Optional[jax.Array] = None,
+                            initializer_range: float = 0.02):
+    """Grow the (tied) token embedding to `new_vocab_size` rows,
+    returning new params — the functional form of the reference's
+    in-place `model.resize_token_embeddings` after special tokens are
+    added (gpt2_train.py:101-112). New rows are N(0, initializer_range)
+    like fresh GPT-2 embeddings. Pair the returned params with a module
+    rebuilt as `GPT2DoubleHeads(cfg.replace(vocab_size=new))` — flax
+    validates parameter shapes against the module config."""
+    params = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    wte = params["params"]["transformer"]["wte"]["embedding"]
+    old_vocab, E = wte.shape
+    if new_vocab_size <= old_vocab:
+        return params
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    new_rows = jax.random.normal(
+        key, (new_vocab_size - old_vocab, E), wte.dtype) * initializer_range
+    params["params"]["transformer"]["wte"]["embedding"] = jnp.concatenate(
+        [wte, new_rows], axis=0)
+    return params
+
+
+# ---- pretrained-weight import (local HF torch checkpoints) --------------
+
+def params_from_hf_state_dict(state_dict: Dict[str, Any],
+                              cfg: GPT2Config,
+                              key: Optional[jax.Array] = None) -> dict:
+    """Convert a HuggingFace PyTorch GPT-2 state dict to this module's
+    parameter pytree. HF's Conv1D stores weights as [in, out] — the
+    same layout as flax.linen.Dense kernels — so projection weights map
+    without transposition; LayerNorm weight/bias map to scale/bias.
+
+    Works with `GPT2LMHeadModel`/`GPT2Model` checkpoints: the MC head
+    (absent from LM-only checkpoints) gets a fresh
+    N(0, initializer_range) kernel from `key` — it is always trained
+    from scratch for PersonaChat anyway."""
+    def t(name):
+        arr = state_dict[name]
+        # torch tensors and numpy arrays both convert via np.asarray
+        return jnp.asarray(np.asarray(arr.detach().cpu()
+                                      if hasattr(arr, "detach") else arr))
+
+    prefix = ""
+    if any(k.startswith("transformer.") for k in state_dict):
+        prefix = "transformer."
+
+    tr: Dict[str, Any] = {
+        "wte": {"embedding": t(prefix + "wte.weight")},
+        "wpe": {"embedding": t(prefix + "wpe.weight")},
+        "ln_f": {"scale": t(prefix + "ln_f.weight"),
+                 "bias": t(prefix + "ln_f.bias")},
+    }
+    for i in range(cfg.n_layer):
+        p = f"{prefix}h.{i}."
+        tr[f"h_{i}"] = {
+            "ln_1": {"scale": t(p + "ln_1.weight"),
+                     "bias": t(p + "ln_1.bias")},
+            "ln_2": {"scale": t(p + "ln_2.weight"),
+                     "bias": t(p + "ln_2.bias")},
+            "attn": {
+                "c_attn": {"kernel": t(p + "attn.c_attn.weight"),
+                           "bias": t(p + "attn.c_attn.bias")},
+                "c_proj": {"kernel": t(p + "attn.c_proj.weight"),
+                           "bias": t(p + "attn.c_proj.bias")},
+            },
+            "mlp": {
+                "c_fc": {"kernel": t(p + "mlp.c_fc.weight"),
+                         "bias": t(p + "mlp.c_fc.bias")},
+                "c_proj": {"kernel": t(p + "mlp.c_proj.weight"),
+                           "bias": t(p + "mlp.c_proj.bias")},
+            },
+        }
+    E = cfg.n_embd
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    mc_kernel = (jax.random.normal(key, (E, 1), jnp.float32)
+                 * cfg.initializer_range)
+    return {"params": {
+        "transformer": tr,
+        "mc_head": {"kernel": mc_kernel,
+                    "bias": jnp.zeros((1,), jnp.float32)},
+    }}
+
+
+def try_load_pretrained(model_checkpoint: str, cfg: GPT2Config,
+                        key: Optional[jax.Array] = None) -> Optional[dict]:
+    """Best-effort local pretrained load through `transformers` (torch
+    CPU). Returns None when no local checkpoint exists — network
+    download is never attempted (zero-egress environment)."""
+    try:
+        from transformers import GPT2LMHeadModel
+        pt = GPT2LMHeadModel.from_pretrained(
+            model_checkpoint, local_files_only=True)
+    except Exception:
+        return None
+    return params_from_hf_state_dict(pt.state_dict(), cfg, key=key)

@@ -32,7 +32,8 @@ import numpy as np
 from commefficient_tpu import models
 from commefficient_tpu.config import Config, num_classes_of_dataset, parse_args
 from commefficient_tpu.data import (
-    FedCIFAR10, FedCIFAR100, FedLoader, FedValLoader, transforms,
+    FedCIFAR10, FedCIFAR100, FedEMNIST, FedImageNet, FedLoader,
+    FedValLoader, transforms,
 )
 from commefficient_tpu.federated.api import FedModel, FedOptimizer
 from commefficient_tpu.utils.checkpoint import (
@@ -67,15 +68,21 @@ def make_compute_loss(model):
 
 # ---------------- data (reference cv_train.py:254-287) -------------------
 
+# name -> (dataset class, transform factory, --test synthetic sizes)
+# (the reference routes all four CV datasets the same way,
+# cv_train.py:254-287; EMNIST synthetic sizes are (writers, imgs/writer),
+# ImageNet's are (train, val) — see each dataset's docstring)
 _DATASETS = {
-    "CIFAR10": (FedCIFAR10, transforms.cifar10_transforms),
-    "CIFAR100": (FedCIFAR100, transforms.cifar100_transforms),
+    "CIFAR10": (FedCIFAR10, transforms.cifar10_transforms, (2048, 512)),
+    "CIFAR100": (FedCIFAR100, transforms.cifar100_transforms, (2048, 512)),
+    "EMNIST": (FedEMNIST, transforms.femnist_transforms, (64, 16)),
+    "ImageNet": (FedImageNet, transforms.imagenet_transforms, (512, 64)),
 }
 
 
 def get_data_loaders(cfg: Config):
     try:
-        dataset_cls, transform_factory = _DATASETS[cfg.dataset_name]
+        dataset_cls, transform_factory, test_sizes = _DATASETS[cfg.dataset_name]
     except KeyError:
         raise ValueError(
             f"cv_train supports {sorted(_DATASETS)}; for PERSONA use "
@@ -85,7 +92,7 @@ def get_data_loaders(cfg: Config):
     # --test smoke: generate a small synthetic dataset when the real
     # archives aren't on disk (the reference's --test mode likewise
     # bypasses real compute, fed_worker.py:117-122)
-    synthetic = (2048, 512) if cfg.do_test else None
+    synthetic = test_sizes if cfg.do_test else None
     train_set = dataset_cls(
         cfg.dataset_dir, transform=train_t, do_iid=cfg.do_iid,
         num_clients=cfg.num_clients, train=True, seed=cfg.seed,
@@ -274,8 +281,14 @@ def main(argv=None) -> bool:
 
     train_loader, val_loader = get_data_loaders(cfg)
 
+    # derive the model's input shape from the actual (transformed)
+    # data — 32x32x3 CIFAR, 28x28x1 EMNIST, 224x224x3 ImageNet all
+    # route through here (the reference hardwires per-dataset
+    # model_config at cv_train.py:345-358)
+    x0 = train_loader.dataset.get_client_batch(0, np.array([0]))[0]
+    model_config["initial_channels"] = int(x0.shape[-1])
     module = models.build_model(cfg.model, **model_config)
-    init_x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    init_x = jnp.zeros((2,) + x0.shape[1:], jnp.float32)
     params = module.init(jax.random.PRNGKey(cfg.seed), init_x)
 
     # finetune: transfer the old body, keep the fresh head, and freeze

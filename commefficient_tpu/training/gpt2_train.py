@@ -1,0 +1,284 @@
+"""GPT2 / PersonaChat federated training driver.
+
+The reference driver's launch surface re-created on the TPU runtime
+(reference: CommEfficient/gpt2_train.py — double-heads loss callbacks
+:77-99, special-token handling :101-112, per-batch-logging train loop
+`run_batches` :169-253, val NLL/accuracy/perplexity :242-253, main
+wiring :255-313): same flags (config.parse_args, default lr 4e-2 at
+:256), same loss-callback contract, same epoch-1-only download
+reporting (:132-137). The federated core underneath is the identical
+workload-agnostic round engine cv_train uses — preserving the
+reference's key API contract (SURVEY.md §3.5).
+
+Run: python -m commefficient_tpu.training.gpt2_train --dataset_name
+PERSONA --mode sketch --error_type virtual ...
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config, parse_args
+from commefficient_tpu.data.loader import FedLoader, FedValLoader
+from commefficient_tpu.data.persona import (
+    FedPERSONA, IGNORE_INDEX, make_tokenizer,
+)
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.models.gpt2 import (
+    GPT2Config, GPT2DoubleHeads, PRESETS, build_gpt2,
+    resize_token_embeddings, try_load_pretrained,
+)
+from commefficient_tpu.utils.checkpoint import save_checkpoint
+from commefficient_tpu.utils.logging import TableLogger, Timer, make_logdir
+from commefficient_tpu.utils.schedules import LambdaLR, PiecewiseLinear
+
+
+# ---------------- loss callbacks (reference gpt2_train.py:77-99) ---------
+
+def _lm_nll(lm_logits, lm_labels, mask):
+    """Shifted next-token NLL over non-ignored labels of valid
+    examples (reference inference() shift at gpt2_train.py:63-68 +
+    CrossEntropyLoss(ignore_index=-1) at :78)."""
+    logits = lm_logits[..., :-1, :]
+    labels = lm_labels[..., 1:]
+    valid = ((labels != IGNORE_INDEX)
+             * mask[:, None, None]).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def _mc_loss_acc(mc_logits, mc_labels, mask):
+    """Candidate-choice cross-entropy + accuracy (the double head)."""
+    logp = jax.nn.log_softmax(mc_logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, mc_labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    acc = ((mc_logits.argmax(-1) == mc_labels) * mask).sum() / denom
+    return loss, acc
+
+
+def make_compute_loss_train(model: GPT2DoubleHeads, cfg: Config):
+    def compute_loss(params, batch, mask):
+        input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
+        lm_logits, mc_logits = model.apply(
+            params, input_ids, token_type_ids, mc_token_ids)
+        lm = _lm_nll(lm_logits, lm_labels, mask)
+        mc, _ = _mc_loss_acc(mc_logits, mc_labels, mask)
+        loss = lm * cfg.lm_coef + mc * cfg.mc_coef
+        return loss, (lm, mc)
+    return compute_loss
+
+
+def make_compute_loss_val(model: GPT2DoubleHeads):
+    """Val = (NLL, (accuracy,)); perplexity is exp(mean NLL), computed
+    by the caller over the whole val set (reference gpt2_train.py:253)."""
+    def compute_loss(params, batch, mask):
+        input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
+        lm_logits, mc_logits = model.apply(
+            params, input_ids, token_type_ids, mc_token_ids)
+        nll = _lm_nll(lm_logits, lm_labels, mask)
+        _, acc = _mc_loss_acc(mc_logits, mc_labels, mask)
+        return nll, (acc,)
+    return compute_loss
+
+
+# ---------------- data (reference gpt2_train.py:315-355) -----------------
+
+def get_data_loaders(cfg: Config, tokenizer):
+    synthetic = (8, 2, 3) if cfg.do_test else None
+    common = dict(dataset_dir=cfg.dataset_dir, tokenizer=tokenizer,
+                  num_candidates=cfg.num_candidates,
+                  max_history=cfg.max_history, do_iid=cfg.do_iid,
+                  seed=cfg.seed, synthetic_examples=synthetic)
+    train_set = FedPERSONA(
+        personality_permutations=cfg.personality_permutations,
+        num_clients=cfg.num_clients, train=True, **common)
+    val_set = FedPERSONA(
+        personality_permutations=cfg.personality_permutations,
+        train=False, **common)
+    train_loader = FedLoader(train_set, cfg.num_workers,
+                             cfg.local_batch_size, seed=cfg.seed)
+    val_loader = FedValLoader(val_set, cfg.valid_batch_size,
+                              num_shards=min(jax.device_count(),
+                                             cfg.num_workers))
+    return train_loader, val_loader
+
+
+# ---------------- eval (reference test_gpt2, gpt2_train.py:149-167) ------
+
+def run_eval(model: FedModel, val_loader):
+    model.train(False)
+    tot_nll = tot_acc = tot_n = 0.0
+    for data, mask in val_loader.batches():
+        nll, acc, count = model((data, mask))
+        tot_nll += float((nll * count).sum())
+        tot_acc += float((acc * count).sum())
+        tot_n += float(count.sum())
+    model.train(True)
+    denom = max(tot_n, 1.0)
+    nll = tot_nll / denom
+    return nll, tot_acc / denom, float(np.exp(min(nll, 50.0)))
+
+
+# ---------------- training loop (reference run_batches, :169-253) --------
+
+def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
+               train_loader, cfg: Config,
+               logger=None, timer: Optional[Timer] = None):
+    timer = timer or Timer()
+    logger = logger or TableLogger()
+    spe = train_loader.steps_per_epoch
+    epoch_download = epoch_upload = 0.0
+    batch_idx = 0
+
+    for epoch in range(math.ceil(cfg.num_epochs)):
+        frac = (cfg.num_epochs - epoch
+                if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
+        losses = []
+        for client_ids, data, mask in train_loader.epoch():
+            if batch_idx - epoch * spe >= spe * frac:
+                break
+            lr_scheduler.step()
+            loss, lm, mc, down, up = model((client_ids, data, mask))
+            opt.step()
+            batch_idx += 1
+            losses.append(float(np.mean(loss)))
+            if epoch == 0:
+                # download deltas are only trusted for epoch 1
+                # (reference gpt2_train.py:132-137)
+                epoch_download += down.sum() / (1024 ** 2)
+                epoch_upload += up.sum() / (1024 ** 2)
+            logger.append({
+                "batch_idx": batch_idx,
+                "lr": round(float(opt.param_groups[0]["lr"]), 5),
+                "train_time": timer(),
+                "train_loss": losses[-1],
+                "lm_loss": float(np.mean(lm)),
+                "mc_loss": float(np.mean(mc)),
+                "total_time": timer.total_time,
+            })
+            if np.isnan(losses[-1]) or losses[-1] > cfg.nan_threshold:
+                print(f"found nan/divergent loss {losses[-1]}, aborting")
+                return False
+
+    n_clients = model.num_clients
+    print(f"Total Download (MiB): {epoch_download:0.2f} (only epoch 1)")
+    print(f"Total Upload (MiB): {epoch_upload:0.2f} (only epoch 1)")
+    print(f"Avg Download Per Client: {epoch_download / n_clients:0.2f}"
+          f" (only epoch 1)")
+    print(f"Avg Upload Per Client: {epoch_upload / n_clients:0.2f}"
+          f" (only epoch 1)")
+    return True
+
+
+def test_gpt2(model: FedModel, val_loader, timer: Optional[Timer] = None,
+              logger=None):
+    timer = timer or Timer()
+    nll, acc, ppl = run_eval(model, val_loader)
+    stats = {"val_nll": nll, "val_acc": acc, "val_ppl": ppl,
+             "val_time": timer(), "total_time": timer.total_time}
+    (logger or TableLogger()).append(stats)
+    return stats
+
+
+# ---------------- main (reference train(), gpt2_train.py:255-313) --------
+
+def build_model_and_params(cfg: Config, tokenizer, seq_len: int):
+    """Build the Flax GPT2 sized for the tokenizer + corpus; import
+    local pretrained weights when available, otherwise random init."""
+    vocab = len(tokenizer)
+    key = jax.random.PRNGKey(cfg.seed)
+    if cfg.do_test:
+        gcfg = GPT2Config(vocab_size=vocab, n_positions=max(seq_len, 8),
+                          n_embd=32, n_layer=2, n_head=2)
+        pretrained = None
+    else:
+        base = PRESETS.get(cfg.model_checkpoint, PRESETS["gpt2"])
+        gcfg = base.replace(n_positions=max(base.n_positions, seq_len))
+        pretrained = try_load_pretrained(cfg.model_checkpoint, gcfg,
+                                         key=key)
+        if pretrained is None:
+            # from-scratch: size the embedding directly for the
+            # tokenizer (no resize step needed)
+            gcfg = gcfg.replace(vocab_size=vocab)
+
+    if pretrained is not None:
+        params = pretrained
+        if vocab > gcfg.vocab_size:
+            # special-token embedding resize (reference :101-112);
+            # the module is rebuilt at the grown vocab to match
+            params = resize_token_embeddings(params, vocab, key=key)
+            gcfg = gcfg.replace(vocab_size=vocab)
+        module = GPT2DoubleHeads(gcfg)
+    else:
+        module = GPT2DoubleHeads(gcfg)
+        C = max(cfg.num_candidates, 1)
+        L = min(seq_len, gcfg.n_positions)
+        params = module.init(key,
+                             jnp.zeros((1, C, L), jnp.int32),
+                             jnp.zeros((1, C, L), jnp.int32),
+                             jnp.zeros((1, C), jnp.int32))
+    return module, params
+
+
+def main(argv=None) -> bool:
+    cfg = parse_args(default_lr=4e-2, argv=argv)
+    if cfg.do_test:
+        # smoke shrink of the compression geometry (cv_train applies
+        # the same pattern; reference cv_train.py:329-336)
+        cfg = cfg.replace(num_rows=1, num_cols=1000, k=10, num_blocks=1)
+    print(cfg)
+    timer = Timer()
+    np.random.seed(cfg.seed)
+
+    tokenizer = make_tokenizer(cfg.model_checkpoint,
+                               fallback_vocab=500 if cfg.do_test else 5000)
+    train_loader, val_loader = get_data_loaders(cfg, tokenizer)
+    # each split pads to its own corpus max; position embeddings must
+    # cover both (out-of-range ids would silently clamp, not raise)
+    seq_len = max(train_loader.dataset.seq_len,
+                  val_loader.dataset.seq_len)
+
+    module, params = build_model_and_params(cfg, tokenizer, seq_len)
+
+    model = FedModel(None, make_compute_loss_train(module, cfg), cfg,
+                     loss_val=make_compute_loss_val(module), params=params,
+                     num_clients=train_loader.dataset.num_clients)
+    opt = FedOptimizer(model)
+
+    spe = train_loader.steps_per_epoch
+    print("Steps per epoch", spe)
+    schedule = PiecewiseLinear([0, cfg.num_epochs * spe],
+                               [cfg.lr_scale, 0.0])
+    lr_scheduler = LambdaLR(opt, lr_lambda=schedule)
+
+    log_dir = make_logdir(cfg)
+    print(f"Finished initializing in {timer():.2f} seconds")
+
+    if cfg.do_finetune:
+        test_gpt2(model, val_loader, timer=timer)
+        ok = True
+    else:
+        ok = train_gpt2(model, opt, lr_scheduler, train_loader,
+                        cfg, logger=TableLogger(), timer=timer)
+        save_checkpoint(os.path.join(log_dir, "gpt2"), model.server,
+                        scheduler_step=lr_scheduler.step_count)
+        test_gpt2(model, val_loader, timer=timer)
+    model.finalize()
+    return ok
+
+
+if __name__ == "__main__":
+    raise SystemExit(0 if main() else 1)

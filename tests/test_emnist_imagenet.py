@@ -1,0 +1,144 @@
+"""EMNIST + ImageNet data layers and their cv_train wiring (reference
+routing: cv_train.py:254-287; data: data_utils/fed_emnist.py,
+fed_imagenet.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.data.emnist import FedEMNIST, read_leaf_dir
+from commefficient_tpu.data.imagenet import FedImageNet
+from commefficient_tpu.training import cv_train
+
+
+# ---- LEAF parser ---------------------------------------------------------
+
+def _write_leaf_fixture(raw_dir, users):
+    os.makedirs(raw_dir, exist_ok=True)
+    shard = {"users": list(users),
+             "num_samples": [len(users[u][1]) for u in users],
+             "user_data": {
+                 u: {"x": [img.reshape(-1).tolist() for img in x],
+                     "y": list(map(int, y))}
+                 for u, (x, y) in users.items()}}
+    with open(os.path.join(raw_dir, "all_data_0.json"), "w") as f:
+        json.dump(shard, f)
+
+
+def _leaf_users(n_users=3, per_user=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return {f"f{u:04d}": (rng.rand(per_user, 28, 28).astype(np.float32),
+                          rng.randint(0, 62, per_user))
+            for u in range(n_users)}
+
+
+def test_read_leaf_dir(tmp_path):
+    users = _leaf_users()
+    _write_leaf_fixture(str(tmp_path / "raw"), users)
+    parsed = read_leaf_dir(str(tmp_path / "raw"))
+    assert sorted(parsed) == sorted(users)
+    for u, (x, y) in users.items():
+        px, py = parsed[u]
+        assert px.shape == (5, 28, 28, 1) and px.dtype == np.uint8
+        np.testing.assert_array_equal(py, y)
+        # float [0,1] -> uint8 round-trip
+        np.testing.assert_allclose(px[..., 0] / 255.0, x, atol=1 / 255.0)
+
+
+def test_emnist_from_leaf_shards(tmp_path):
+    users = _leaf_users(n_users=4, per_user=6)
+    _write_leaf_fixture(str(tmp_path / "EMNIST" / "raw" / "train"), users)
+    _write_leaf_fixture(str(tmp_path / "EMNIST" / "raw" / "test"),
+                        _leaf_users(n_users=2, per_user=3, seed=1))
+    ds = FedEMNIST(str(tmp_path), train=True)
+    assert ds.num_clients == 4
+    np.testing.assert_array_equal(ds.images_per_client, [6] * 4)
+    x, y = ds.get_client_batch(2, np.array([0, 3]))
+    assert x.shape == (2, 28, 28, 1)
+    assert ds.num_val_images == 6
+    vx, vy = ds.get_val_batch(np.array([0, 5]))
+    assert vx.shape == (2, 28, 28, 1)
+
+
+def test_emnist_synthetic(tmp_path):
+    ds = FedEMNIST(str(tmp_path), train=True,
+                   synthetic_examples=(8, 12), seed=3)
+    assert ds.num_clients == 8
+    np.testing.assert_array_equal(ds.images_per_client, [12] * 8)
+    x, y = ds.get_client_batch(0, np.arange(4))
+    assert x.shape == (4, 28, 28, 1) and (y >= 0).all() and (y < 62).all()
+
+
+# ---- ImageNet layouts ----------------------------------------------------
+
+def test_imagenet_preprocessed_layout(tmp_path):
+    pre = tmp_path / "ImageNet" / "preprocessed"
+    pre.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    for c in range(3):
+        np.save(str(pre / f"client{c}.npy"),
+                rng.randint(0, 255, (4 + c, 8, 8, 3), dtype=np.uint8))
+    np.savez(str(pre / "val.npz"),
+             images=rng.randint(0, 255, (5, 8, 8, 3), dtype=np.uint8),
+             labels=rng.randint(0, 3, 5))
+    ds = FedImageNet(str(tmp_path), train=True)
+    np.testing.assert_array_equal(ds.images_per_client, [4, 5, 6])
+    x, y = ds.get_client_batch(1, np.array([0, 2]))
+    assert x.shape == (2, 8, 8, 3)
+    np.testing.assert_array_equal(y, [1, 1])  # label == wnid client
+    assert ds.num_val_images == 5
+
+
+def test_imagenet_raw_jpeg_layout(tmp_path):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    raw = tmp_path / "ImageNet" / "raw" / "train"
+    rng = np.random.RandomState(0)
+    for w, wnid in enumerate(["n01440764", "n01443537"]):
+        d = raw / wnid
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = Image.fromarray(
+                rng.randint(0, 255, (16, 20, 3), dtype=np.uint8))
+            img.save(str(d / f"{wnid}_{i}.JPEG"))
+    ds = FedImageNet(str(tmp_path), train=True, image_size=8)
+    np.testing.assert_array_equal(ds.images_per_client, [3, 3])
+    x, y = ds.get_client_batch(0, np.array([0, 1]))
+    assert x.shape == (2, 8, 8, 3)  # decoded + resized
+    np.testing.assert_array_equal(y, [0, 0])
+
+
+def test_imagenet_synthetic(tmp_path):
+    ds = FedImageNet(str(tmp_path), train=True,
+                     synthetic_examples=(64, 16), seed=1)
+    assert ds.num_clients == 16
+    x, y = ds.get_client_batch(5, np.arange(2))
+    assert x.shape[0] == 2 and x.shape[-1] == 3
+
+
+def test_imagenet_refuses_download(tmp_path):
+    with pytest.raises((RuntimeError, FileNotFoundError)):
+        FedImageNet(str(tmp_path / "none"), train=True, download=True)
+
+
+# ---- driver wiring -------------------------------------------------------
+
+def _run_cv(tmp_path, dataset, *extra):
+    return cv_train.main([
+        "--test", "--dataset_name", dataset,
+        "--dataset_dir", str(tmp_path / "ds"),
+        "--local_momentum", "0.0", "--mode", "sketch",
+        "--error_type", "virtual", "--virtual_momentum", "0.9",
+        "--num_workers", "8", "--local_batch_size", "4",
+        "--num_epochs", "0.05", "--valid_batch_size", "16",
+        "--lr_scale", "0.1", *extra])
+
+
+def test_cv_train_emnist_end_to_end(tmp_path):
+    assert _run_cv(tmp_path, "EMNIST")
+
+
+def test_cv_train_imagenet_end_to_end(tmp_path):
+    assert _run_cv(tmp_path, "ImageNet")

@@ -1,0 +1,142 @@
+"""FedPERSONA data-layer tests: persona partitioning, nested index
+math, segment grammar, and candidate-batch shapes (reference contract:
+data_utils/fed_persona.py:144-147,195-215,304,330-392)."""
+import numpy as np
+import pytest
+
+from commefficient_tpu.data.persona import (
+    FedPERSONA, HashTokenizer, IGNORE_INDEX, build_input_from_segments,
+    utterance_to_arrays,
+)
+
+TOK = HashTokenizer(vocab_size=200)
+SP = TOK.special_ids()
+
+
+@pytest.fixture()
+def persona_set(tmp_path):
+    def make(train=True, **kw):
+        base = dict(dataset_dir=str(tmp_path), tokenizer=TOK,
+                    num_candidates=2, max_history=2,
+                    synthetic_examples=(6, 2, 3), seed=0)
+        base.update(kw)
+        return FedPERSONA(train=train, **base)
+    return make
+
+
+# ---- segment building ----------------------------------------------------
+
+def test_segment_grammar():
+    persona = [[10, 11], [12]]
+    history = [[20, 21], [22]]
+    reply = [30, 31]
+    inst = build_input_from_segments(persona, history, reply, SP,
+                                     lm_labels=True)
+    ids = inst["input_ids"]
+    # starts with <bos> + flattened persona
+    assert ids[:4] == [SP["<bos>"], 10, 11, 12]
+    # ends with reply + <eos>, prefixed by <speaker2>
+    assert ids[-4:] == [SP["<speaker2>"], 30, 31, SP["<eos>"]]
+    # mc token is the last position
+    assert inst["mc_token_ids"] == len(ids) - 1
+    # lm labels: ignore everywhere except the reply tokens after the
+    # speaker token
+    labels = inst["lm_labels"]
+    assert len(labels) == len(ids)
+    n_live = sum(1 for l in labels if l != IGNORE_INDEX)
+    assert n_live == 3  # 30, 31, <eos>
+    assert labels[-3:] == [30, 31, SP["<eos>"]]
+    # token types cover every token and only use speaker ids
+    assert set(inst["token_type_ids"]) <= {SP["<speaker1>"],
+                                           SP["<speaker2>"]}
+
+
+def test_wrong_candidate_has_no_lm_labels():
+    inst = build_input_from_segments([[10]], [[20]], [30], SP,
+                                     lm_labels=False)
+    assert all(l == IGNORE_INDEX for l in inst["lm_labels"])
+
+
+def test_utterance_to_arrays_shapes_and_truncation():
+    persona = ["hello world", "foo bar"]
+    history = [f"turn {i}" for i in range(10)]
+    cands = ["wrong one", "also wrong", "the right reply"]
+    ii, mt, lb, ml, tt = utterance_to_arrays(
+        persona, history, cands, TOK, num_candidates=2, max_history=2)
+    # restricted to last num_candidates=2; last is correct
+    assert ii.shape[0] == 2 and ml == 1
+    assert ii.shape == lb.shape == tt.shape
+    assert mt.shape == (2,)
+    # only the correct candidate carries lm labels
+    assert (lb[0] == IGNORE_INDEX).all()
+    assert (lb[1] != IGNORE_INDEX).any()
+    # history truncated to 2*max_history+1 = 5 turns: turn 9's token
+    # must appear, turn 4's must not
+    t9 = TOK.tokenize("9")[0]
+    t4 = TOK.tokenize("4")[0]
+    assert t9 in ii[1]
+    assert t4 not in ii
+
+
+# ---- partition geometry --------------------------------------------------
+
+def test_persona_partition_geometry(persona_set):
+    ds = persona_set(train=True)
+    # 6 personas x 2 dialogs each, each dialog has 3 utterances
+    assert ds.num_clients == 6
+    np.testing.assert_array_equal(ds.data_per_client, [6] * 6)
+    assert len(ds) == 36
+
+
+def test_personality_permutations_scale_corpus(persona_set, tmp_path):
+    ds = persona_set(train=True, personality_permutations=2)
+    np.testing.assert_array_equal(ds.data_per_client, [12] * 6)
+    # permuted copies differ in persona region but share the reply
+    a = ds.get_client_batch(0, np.array([0]))
+    b = ds.get_client_batch(0, np.array([1]))
+    assert not np.array_equal(a[0], b[0])      # rotated persona
+    np.testing.assert_array_equal(a[3], b[3])  # same mc label
+
+
+def test_client_batch_shapes(persona_set):
+    ds = persona_set(train=True)
+    ii, mt, lb, ml, tt = ds.get_client_batch(3, np.arange(4))
+    C, L = ii.shape[1], ii.shape[2]
+    assert C == 2
+    assert ii.shape == (4, C, L) == lb.shape == tt.shape
+    assert mt.shape == (4, C)
+    assert ml.shape == (4,)
+    assert (ml == C - 1).all()  # last candidate is always correct
+    assert ii.dtype == np.int32
+    # mc token ids point at real positions
+    assert (mt >= 0).all() and (mt < L).all()
+
+
+def test_val_keeps_all_candidates(persona_set):
+    ds = persona_set(train=False)
+    ii, mt, lb, ml, tt = ds.get_val_batch(np.arange(3))
+    assert ii.shape[1] >= 2
+    assert (ml == ii.shape[1] - 1).all()
+    assert ds.num_val_images > 0
+
+
+def test_iid_reshuffle(persona_set):
+    ds = persona_set(train=True, do_iid=True, num_clients=4)
+    assert ds.num_clients == 4
+    assert ds.data_per_client.sum() == 36
+    batch = ds.get_client_batch(0, np.arange(2))
+    assert batch[0].shape[0] == 2
+
+
+def test_loader_roundtrip(persona_set):
+    """FedLoader stacks persona batches into [W, B, C, L] blocks."""
+    from commefficient_tpu.data.loader import FedLoader
+
+    ds = persona_set(train=True)
+    loader = FedLoader(ds, num_workers=2, local_batch_size=3, seed=0)
+    ids, data, mask = next(iter(loader.epoch()))
+    assert ids.shape == (2,)
+    ii, mt, lb, ml, tt = data
+    assert ii.shape[0] == 2 and ii.shape[1] == 3
+    assert ii.ndim == 4
+    assert mask.shape == (2, 3)
