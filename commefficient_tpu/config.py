@@ -65,6 +65,11 @@ class Config:
     dataset_dir: str = "./dataset"
     do_batchnorm: bool = False
     nan_threshold: float = 999.0
+    # dump a jax.profiler trace of the first training epoch into
+    # <logdir>/profile (viewable in TensorBoard/Perfetto) — the TPU
+    # equivalent of the reference's dormant cProfile scaffolding
+    # (fed_aggregator.py:46-52; SURVEY.md §5 tracing row)
+    do_profile: bool = False
 
     # compression (utils.py:142-147)
     k: int = 50000
@@ -99,6 +104,14 @@ class Config:
     scan_span: int = 0
     num_clients: Optional[int] = None
     num_workers: int = 1
+    # cap on the static per-client batch dim when local_batch_size=-1
+    # (whole-client batches). Uncapped, fedavg at ImageNet scale stages
+    # max(data_per_client) examples per client slot (~2.4 GB f32 at
+    # 1300x224x224x3) — the cap bounds staging memory; clients with
+    # more data participate in consecutive rounds on successive chunks
+    # (a documented divergence: the reference instead serializes whole
+    # clients one at a time per GPU, fed_worker.py:68-77).
+    max_local_batch: int = -1
     device: str = "tpu"
     num_devices: int = 1
     share_ps_gpu: bool = False
@@ -231,6 +244,8 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--dataset_dir", type=str, default="./dataset")
     p.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
     p.add_argument("--nan_threshold", type=float, default=999)
+    p.add_argument("--profile", action="store_true", dest="do_profile",
+                   help="jax.profiler trace of the first epoch")
 
     p.add_argument("--k", type=int, default=50000)
     p.add_argument("--num_cols", type=int, default=500000)
@@ -252,6 +267,10 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--port", type=int, default=5315)
     p.add_argument("--num_clients", type=int)
     p.add_argument("--num_workers", type=int, default=1)
+    p.add_argument("--max_local_batch", type=int, default=-1,
+                   help="cap the static per-client batch dim when "
+                        "local_batch_size=-1 (bounds device staging "
+                        "memory at ImageNet scale)")
     p.add_argument("--device", type=str, default="tpu")
     p.add_argument("--num_devices", type=int, default=1)
     p.add_argument("--share_ps_gpu", action="store_true")

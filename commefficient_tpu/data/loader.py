@@ -23,10 +23,12 @@ class FedLoader:
     into (client_ids [W], data pytree [W, B, ...], mask [W, B])."""
 
     def __init__(self, dataset: FedDataset, num_workers: int,
-                 local_batch_size: int, seed: int = 0):
+                 local_batch_size: int, seed: int = 0,
+                 max_local_batch: int = -1):
         self.dataset = dataset
         self.sampler = FedSampler(dataset.data_per_client, num_workers,
-                                  local_batch_size, seed=seed)
+                                  local_batch_size, seed=seed,
+                                  max_local_batch=max_local_batch)
 
     @property
     def steps_per_epoch(self) -> int:

@@ -291,8 +291,13 @@ class FedPERSONA(FedDataset):
         return os.path.join(self.dataset_dir, self.dataset_name)
 
     def _key(self):
+        # the cache key must pin the tokenizer identity: ids from a
+        # different tokenizer/vocab are silently wrong if reused
+        tok = f"{type(self.tokenizer).__name__}{len(self.tokenizer)}"
+        syn = ("" if self._synthetic_examples is None
+               else "_s" + "x".join(map(str, self._synthetic_examples)))
         return (f"c{self.num_candidates}_h{self.max_history}"
-                f"_p{self.personality_permutations}")
+                f"_p{self.personality_permutations}_{tok}{syn}")
 
     def _npz_path(self, split: str) -> str:
         return os.path.join(self._dir(), f"{split}_{self._key()}.npz")
@@ -348,15 +353,22 @@ class FedPERSONA(FedDataset):
             for ex in self._examples_of(dialog, train):
                 examples.append(ex)
 
-        # two passes: find the corpus max length, then materialize at
-        # one static [N, C, L]
+        # two passes over a streamed build: pass 1 finds the corpus
+        # (C, L) envelope, pass 2 fills the preallocated block directly
+        # — per-utterance arrays are never held all at once (the memo
+        # makes the second tokenization pass nearly free)
         ncand = self.num_candidates if train else 0  # val keeps all
         memo = _MemoTokenizer(self.tokenizer)
-        probe = [utterance_to_arrays(p, h, c, memo, ncand,
-                                     self.max_history)
-                 for p, h, c in examples]
-        L = max(int(arrs[0].shape[1]) for arrs in probe) if probe else 1
-        C = max(int(arrs[0].shape[0]) for arrs in probe) if probe else 1
+
+        def stream():
+            for p, h, c in examples:
+                yield utterance_to_arrays(p, h, c, memo, ncand,
+                                          self.max_history)
+
+        C = L = 1
+        for arrs in stream():
+            C = max(C, int(arrs[0].shape[0]))
+            L = max(L, int(arrs[0].shape[1]))
 
         N = len(examples)
         pad = self.tokenizer.special_ids()["<pad>"]
@@ -365,7 +377,7 @@ class FedPERSONA(FedDataset):
         labels = np.full((N, C, L), IGNORE_INDEX, np.int32)
         mc_token_ids = np.zeros((N, C), np.int32)
         mc_labels = np.zeros((N,), np.int32)
-        for i, arrs in enumerate(probe):
+        for i, arrs in enumerate(stream()):
             ii, mt, lb, ml, tt = arrs
             c, l = ii.shape
             input_ids[i, :c, :l] = ii

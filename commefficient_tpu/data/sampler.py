@@ -32,27 +32,47 @@ class RoundIndices(NamedTuple):
 class FedSampler:
     def __init__(self, data_per_client: np.ndarray, num_workers: int,
                  local_batch_size: int, seed: int = 0,
-                 shuffle_clients: bool = True):
+                 shuffle_clients: bool = True,
+                 max_local_batch: int = -1):
+        """max_local_batch caps the static batch dim B when
+        local_batch_size == -1 (whole-client batches): a client with
+        more data than the cap stays non-exhausted and participates in
+        consecutive rounds on successive chunks. Bounds the
+        [num_workers, B, ...] staging arrays that are otherwise sized
+        by max(data_per_client) — the ImageNet-scale memory hazard."""
         self.data_per_client = np.asarray(data_per_client)
         self.num_clients = len(self.data_per_client)
         self.num_workers = num_workers
         self.local_batch_size = local_batch_size
+        self.max_local_batch = max_local_batch
         self.rng = np.random.RandomState(seed)
         self.shuffle_clients = shuffle_clients
         if num_workers > self.num_clients:
             raise ValueError(
                 f"num_workers={num_workers} > num_clients={self.num_clients}")
 
+    def _cap(self, take: np.ndarray | int):
+        """Applies ONLY to whole-client (-1) batches, per the flag's
+        documented contract; explicit local_batch_size is untouched."""
+        if self.local_batch_size == -1 and self.max_local_batch > 0:
+            return np.minimum(take, self.max_local_batch)
+        return take
+
     @property
     def round_batch_size(self) -> int:
         """Static per-client batch dimension B."""
         if self.local_batch_size == -1:
-            return int(self.data_per_client.max())
+            return int(self._cap(int(self.data_per_client.max())))
         return self.local_batch_size
 
     def steps_per_epoch(self) -> int:
-        """(reference utils.py:315-321)"""
+        """(reference utils.py:315-321; capped whole-client batches
+        count each client once per chunk)"""
         if self.local_batch_size == -1:
+            if self.max_local_batch > 0:
+                participations = int(np.ceil(
+                    self.data_per_client / self.max_local_batch).sum())
+                return max(participations // self.num_workers, 1)
             return int(self.num_clients // self.num_workers)
         total = int(self.data_per_client.sum())
         return int(np.ceil(total / (self.local_batch_size * self.num_workers)))
@@ -75,6 +95,7 @@ class FedSampler:
                 remaining = dpc[cid] - cursor[cid]
                 take = remaining if self.local_batch_size == -1 else min(
                     remaining, self.local_batch_size)
+                take = int(self._cap(take))
                 sel = perms[cid][cursor[cid]:cursor[cid] + take]
                 idx[w, :take] = sel
                 mask[w, :take] = 1.0

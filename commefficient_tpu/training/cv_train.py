@@ -102,7 +102,8 @@ def get_data_loaders(cfg: Config):
         num_clients=cfg.num_clients, train=False, seed=cfg.seed,
         synthetic_examples=synthetic)
     train_loader = FedLoader(train_set, cfg.num_workers,
-                             cfg.local_batch_size, seed=cfg.seed)
+                             cfg.local_batch_size, seed=cfg.seed,
+                             max_local_batch=cfg.max_local_batch)
     val_loader = FedValLoader(val_set, cfg.valid_batch_size,
                               num_shards=min(jax.device_count(),
                                              cfg.num_workers))
@@ -142,8 +143,16 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
     if cfg.use_tensorboard:
         writer = _try_tensorboard(log_dir)
 
+    profiling = False
+    profiled = False
     while rounds_done < total_rounds:
         epoch += 1
+        if cfg.do_profile and not profiled:
+            # device-level trace of the first trained epoch (compile +
+            # steady-state rounds), viewable in TensorBoard/Perfetto
+            jax.profiler.start_trace(
+                os.path.join(log_dir or ".", "profile"))
+            profiling = profiled = True
         epoch_rounds = min(spe, total_rounds - rounds_done)
         losses, accs = [], []
         down = np.zeros(model.num_clients)
@@ -202,6 +211,11 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
         total_down += down
         total_up += up
+        if profiling:
+            jax.profiler.stop_trace()
+            profiling = False
+            print(f"profile trace written to "
+                  f"{os.path.join(log_dir or '.', 'profile')}")
         train_time = timer()
 
         mean_loss = float(np.mean(losses)) if losses else float("nan")
@@ -391,5 +405,10 @@ def _fixup_lr_scales(params) -> np.ndarray:
     return np.concatenate(segs)
 
 
-if __name__ == "__main__":
+def cli() -> None:
+    """Console entry point (`cv-train`, pyproject.toml)."""
     raise SystemExit(0 if main() else 1)
+
+
+if __name__ == "__main__":
+    cli()

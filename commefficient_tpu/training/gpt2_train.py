@@ -109,7 +109,8 @@ def get_data_loaders(cfg: Config, tokenizer):
         personality_permutations=cfg.personality_permutations,
         train=False, **common)
     train_loader = FedLoader(train_set, cfg.num_workers,
-                             cfg.local_batch_size, seed=cfg.seed)
+                             cfg.local_batch_size, seed=cfg.seed,
+                             max_local_batch=cfg.max_local_batch)
     val_loader = FedValLoader(val_set, cfg.valid_batch_size,
                               num_shards=min(jax.device_count(),
                                              cfg.num_workers))
@@ -136,13 +137,16 @@ def run_eval(model: FedModel, val_loader):
 
 def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                train_loader, cfg: Config,
-               logger=None, timer: Optional[Timer] = None):
+               logger=None, timer: Optional[Timer] = None,
+               log_dir: str = ""):
     timer = timer or Timer()
     logger = logger or TableLogger()
     spe = train_loader.steps_per_epoch
     epoch_download = epoch_upload = 0.0
     batch_idx = 0
 
+    if cfg.do_profile:
+        jax.profiler.start_trace(os.path.join(log_dir or ".", "profile"))
     for epoch in range(math.ceil(cfg.num_epochs)):
         frac = (cfg.num_epochs - epoch
                 if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
@@ -171,7 +175,13 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
             })
             if np.isnan(losses[-1]) or losses[-1] > cfg.nan_threshold:
                 print(f"found nan/divergent loss {losses[-1]}, aborting")
+                if cfg.do_profile and epoch == 0:
+                    jax.profiler.stop_trace()
                 return False
+        if cfg.do_profile and epoch == 0:
+            jax.profiler.stop_trace()
+            print(f"profile trace written to "
+                  f"{os.path.join(log_dir or '.', 'profile')}")
 
     n_clients = model.num_clients
     print(f"Total Download (MiB): {epoch_download:0.2f} (only epoch 1)")
@@ -272,7 +282,8 @@ def main(argv=None) -> bool:
         ok = True
     else:
         ok = train_gpt2(model, opt, lr_scheduler, train_loader,
-                        cfg, logger=TableLogger(), timer=timer)
+                        cfg, logger=TableLogger(), timer=timer,
+                        log_dir=log_dir)
         save_checkpoint(os.path.join(log_dir, "gpt2"), model.server,
                         scheduler_step=lr_scheduler.step_count)
         test_gpt2(model, val_loader, timer=timer)
@@ -280,5 +291,10 @@ def main(argv=None) -> bool:
     return ok
 
 
-if __name__ == "__main__":
+def cli() -> None:
+    """Console entry point (`gpt2-train`, pyproject.toml)."""
     raise SystemExit(0 if main() else 1)
+
+
+if __name__ == "__main__":
+    cli()

