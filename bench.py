@@ -16,13 +16,15 @@ i.e. >1.0 means faster than a faithful per-client-serialized port.
 
 Robustness (round-1 verdict: the bench crashed on a flaky TPU tunnel
 and left zero perf evidence):
-  * backend init retries with backoff, guarded by SIGALRM so a hung
-    tunnel can't eat the whole bench window;
-  * CPU fallback when the TPU never comes up — the JSON line then
-    carries "platform": "cpu" so a degraded run is never mistaken for
-    a TPU number;
-  * every stage (compile, measure) is alarm-guarded; diagnostics go to
-    stderr, stdout carries exactly ONE JSON line.
+  * the measurement runs in a CHILD process under a hard wall-clock
+    timeout — a hung TPU tunnel blocks inside C++ where SIGALRM never
+    fires, so process isolation is the only reliable watchdog;
+  * if the TPU child dies or times out, the orchestrator relaunches on
+    CPU — the JSON line then carries "platform": "cpu" so a degraded
+    run is never mistaken for a TPU number;
+  * inside the child, backend init retries with backoff and every
+    stage is additionally alarm-guarded; diagnostics go to stderr,
+    stdout carries exactly ONE JSON line.
 
 Extra fields beyond the required four: platform, device_kind,
 flops_per_round (XLA cost analysis), tflops_per_s, mfu (vs the chip's
@@ -33,6 +35,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import subprocess
 import sys
 import time
 
@@ -61,19 +64,34 @@ class StageTimeout(Exception):
     pass
 
 
+# wall-clock budget for the whole child process, set by the
+# orchestrator: every stage's alarm is clamped so the child finishes
+# (or fails fast to the CPU fallback) BEFORE the parent's hard kill —
+# otherwise a healthy-but-slow TPU run would be killed mid-measure.
+_DEADLINE = None
+
+
 class alarm_guard:
     """SIGALRM watchdog: raises StageTimeout if the stage hangs (the
-    round-1 failure mode: jax.devices() sat on a dead tunnel)."""
+    round-1 failure mode: jax.devices() sat on a dead tunnel). Note a
+    hang inside a blocking C call defers signal delivery — the parent
+    process watchdog is the real backstop for that case."""
 
     def __init__(self, seconds, label):
         self.seconds = seconds
         self.label = label
 
     def __enter__(self):
+        seconds = self.seconds
+        if _DEADLINE is not None:
+            remaining = int(_DEADLINE - time.time())
+            if remaining <= 0:
+                raise StageTimeout(f"{self.label} (child budget spent)")
+            seconds = min(seconds, remaining)
         def handler(signum, frame):
             raise StageTimeout(self.label)
         self._old = signal.signal(signal.SIGALRM, handler)
-        signal.alarm(self.seconds)
+        signal.alarm(seconds)
 
     def __exit__(self, *exc):
         signal.alarm(0)
@@ -269,13 +287,70 @@ def main() -> int:
     return 0
 
 
-if __name__ == "__main__":
+def _worker_main() -> int:
+    global _DEADLINE
+    budget = os.environ.get("BENCH_CHILD_BUDGET")
+    if budget:
+        _DEADLINE = time.time() + int(budget)
     try:
-        raise SystemExit(main())
+        return main()
     except StageTimeout as e:
         log(f"FATAL: stage timed out: {e}")
-        print(json.dumps({
-            "metric": "cifar10_resnet9_sketch_round_time",
-            "value": None, "unit": "ms/round", "vs_baseline": None,
-            "error": f"stage timeout: {e}"}), flush=True)
-        raise SystemExit(0)
+        return 3
+
+
+def _run_child(extra_env, timeout_s):
+    """Run the measurement in a child process; returns the parsed JSON
+    line or None. A hard kill-on-timeout is the only watchdog that
+    works when the TPU tunnel hangs inside C++."""
+    env = {**os.environ, "BENCH_IS_WORKER": "1",
+           "BENCH_CHILD_BUDGET": str(max(timeout_s - 60, 30)),
+           **extra_env}
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        log(f"child timed out after {timeout_s}s ({extra_env})")
+        return None
+    for line in r.stderr.splitlines()[-20:]:
+        log(f"  child: {line}")
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    log(f"child rc={r.returncode}, no JSON line")
+    return None
+
+
+def orchestrate() -> int:
+    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
+    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+
+    out = None
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        out = _run_child({}, tpu_timeout)
+        if out is not None and out.get("platform") == "cpu":
+            log("TPU child self-degraded to CPU")
+    if out is None:
+        log("falling back to a CPU child (BENCH_SMALL geometry)")
+        out = _run_child({"JAX_PLATFORMS": "cpu", "BENCH_SMALL": "1",
+                          "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                                        + " --xla_force_host_platform"
+                                          "_device_count=8").strip()},
+                         cpu_timeout)
+    if out is None:
+        out = {"metric": "cifar10_resnet9_sketch_round_time",
+               "value": None, "unit": "ms/round", "vs_baseline": None,
+               "error": "all bench children failed or timed out"}
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if os.environ.get("BENCH_IS_WORKER") == "1":
+        raise SystemExit(_worker_main())
+    raise SystemExit(orchestrate())

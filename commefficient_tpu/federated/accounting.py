@@ -43,6 +43,11 @@ DEQUE_MAXLEN_MULT = 10  # (reference fed_aggregator.py:21)
 _POPCOUNT_TABLE = np.array([bin(i).count("1") for i in range(256)],
                            dtype=np.uint32)
 
+try:  # fused C kernels (commefficient_tpu/native/accounting.c)
+    from commefficient_tpu.native import native_accounting as _native
+except ImportError:
+    _native = None
+
 
 def pack_change_bits(update: jax.Array) -> jax.Array:
     """Device-side: pack (update != 0) into uint32 words. Runs under
@@ -57,7 +62,39 @@ def pack_change_bits(update: jax.Array) -> jax.Array:
 
 
 def _popcount(words: np.ndarray) -> int:
+    if _native is not None:
+        return int(_native.popcount_words(
+            np.ascontiguousarray(words).data))
     return int(_POPCOUNT_TABLE[words.view(np.uint8)].sum())
+
+
+def _prefix_or_popcounts(changes, depths, n_words: int) -> dict:
+    """{s: popcount(OR of the last s change bitsets)} for each needed
+    staleness s in `depths`. The OR prefix must walk every depth up to
+    max(depths) either way; the C fast path fuses OR+popcount in one
+    64-bit pass per depth, while the numpy fallback popcounts ONLY at
+    the requested depths (each popcount materializes a byte-table
+    temporary, so popcounting every depth would dominate)."""
+    depths = sorted(set(int(d) for d in depths))
+    if not depths:
+        return {}
+    max_depth = depths[-1]
+    if _native is not None and max_depth > 0:
+        # zero-copy: each deque entry's buffer is consumed directly
+        rows = [np.ascontiguousarray(np.asarray(c), np.uint32).data
+                for c in changes]
+        counts = _native.prefix_or_popcounts(rows, n_words, max_depth)
+        return {d: counts[d] for d in depths}
+    out = {}
+    if depths[0] == 0:
+        out[0] = 0
+    acc = np.zeros(n_words, np.uint32)
+    need = set(depths)
+    for d in range(1, max_depth + 1):
+        acc |= changes[-d]
+        if d in need:
+            out[d] = int(_POPCOUNT_TABLE[acc.view(np.uint8)].sum())
+    return out
 
 
 class CommAccountant:
@@ -104,19 +141,12 @@ class CommAccountant:
         else:
             if prev_changed_words is not None:
                 self.changes.append(np.asarray(prev_changed_words))
-            if len(self.changes):
+            if len(self.changes) and len(participating):
                 stale = np.clip(self.stale[participating], 0,
                                 len(self.changes))
-                # unique staleness values share one OR-reduction prefix
-                order = np.argsort(stale)
-                acc = np.zeros(self.n_words, np.uint32)
-                depth = 0
-                counts = {0: 0}
-                for s in np.unique(stale):
-                    while depth < s:
-                        depth += 1
-                        acc |= self.changes[-depth]
-                    counts[int(s)] = _popcount(acc)
+                # staleness values share one OR-reduction prefix walk
+                counts = _prefix_or_popcounts(
+                    self.changes, np.unique(stale), self.n_words)
                 download[participating] = [
                     4.0 * counts[int(s)] for s in stale]
             self.stale[participating] = 0

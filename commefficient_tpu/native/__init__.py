@@ -1,0 +1,13 @@
+"""Native (C) fast paths with pure-numpy fallbacks.
+
+`accounting.c` fuses the OR-prefix + popcount walk of the download
+accountant (see federated/accounting.py). Import `native_accounting`
+from here; it is None when the extension isn't built, and callers keep
+their numpy path.
+"""
+from __future__ import annotations
+
+try:
+    from commefficient_tpu.native import _native_accounting as native_accounting
+except ImportError:  # extension not built — numpy fallback in use
+    native_accounting = None

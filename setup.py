@@ -1,0 +1,41 @@
+"""Builds the optional native accounting extension alongside the pure
+package metadata in pyproject.toml (the reference ships a plain
+setup.py, /root/reference/setup.py:1-16). The extension is best-effort:
+if no C toolchain is available, installation proceeds and
+federated/accounting.py uses its numpy fallback."""
+import platform
+
+from setuptools import setup
+from setuptools.command.build_ext import build_ext
+from setuptools.extension import Extension
+
+
+class OptionalBuildExt(build_ext):
+    def run(self):
+        try:
+            super().run()
+        except Exception as e:
+            print(f"native extension skipped ({e}); numpy fallback in use")
+
+    def build_extension(self, ext):
+        try:
+            super().build_extension(ext)
+        except Exception as e:
+            print(f"native extension skipped ({e}); numpy fallback in use")
+
+
+setup(
+    ext_modules=[
+        Extension(
+            "commefficient_tpu.native._native_accounting",
+            sources=["commefficient_tpu/native/accounting.c"],
+            extra_compile_args=(
+                ["-O3", "-funroll-loops"]
+                # hardware POPCNT is an x86 flag; other arches get it
+                # from -O3 + __builtin_popcountll natively
+                + (["-mpopcnt"] if platform.machine() in
+                   ("x86_64", "AMD64", "i686") else [])),
+        )
+    ],
+    cmdclass={"build_ext": OptionalBuildExt},
+)

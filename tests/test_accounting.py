@@ -144,3 +144,63 @@ def test_accountant_state_roundtrip():
     da, _ = ca.record_round(np.array([2]), c2)
     db, _ = cb.record_round(np.array([2]), c2)
     np.testing.assert_allclose(db, da)
+
+
+def test_native_matches_numpy_prefix_or_popcounts():
+    """The C accounting kernel (commefficient_tpu/native/accounting.c)
+    must agree exactly with the numpy fallback, incl. odd word counts
+    exercising the 64-bit-pair + tail path."""
+    from commefficient_tpu.federated import accounting as acct_mod
+
+    if acct_mod._native is None:
+        pytest.skip("native extension not built")
+
+    rng = np.random.RandomState(7)
+    for n_words in (1, 2, 7, 64, 129):
+        rows = [rng.randint(0, 2**32, n_words).astype(np.uint32)
+                for _ in range(6)]
+        depths = [0, 2, 5]
+        native = acct_mod._prefix_or_popcounts(rows, depths, n_words)
+        # numpy fallback, forced
+        saved = acct_mod._native
+        acct_mod._native = None
+        try:
+            fallback = acct_mod._prefix_or_popcounts(rows, depths, n_words)
+        finally:
+            acct_mod._native = saved
+        assert native == fallback, n_words
+        assert sorted(native) == depths
+
+
+def test_accounting_identical_with_and_without_native():
+    """End-to-end: record_round byte totals are bit-identical on both
+    paths."""
+    from commefficient_tpu.federated import accounting as acct_mod
+
+    if acct_mod._native is None:
+        pytest.skip("native extension not built")
+
+    def run():
+        acct = CommAccountant(cfg_for(num_workers=2), num_clients=6)
+        rng = np.random.RandomState(3)
+        prev = None
+        out = []
+        for r in range(8):
+            ids = rng.choice(6, 2, replace=False)
+            d, u = acct.record_round(ids, prev)
+            prev = np.asarray(pack_change_bits(
+                jnp.zeros(64).at[jnp.asarray(
+                    rng.choice(64, 5, replace=False))].set(1.0)))
+            out.append((d.copy(), u.copy()))
+        return out
+
+    native_out = run()
+    saved = acct_mod._native
+    acct_mod._native = None
+    try:
+        fallback_out = run()
+    finally:
+        acct_mod._native = saved
+    for (dn, un), (df, uf) in zip(native_out, fallback_out):
+        np.testing.assert_array_equal(dn, df)
+        np.testing.assert_array_equal(un, uf)
