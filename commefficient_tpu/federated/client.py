@@ -62,6 +62,16 @@ def make_flat_grad_fn(loss_fn: LossFn, unravel: Callable):
     return flat_grad
 
 
+def make_flat_loss_fn(loss_fn: LossFn, unravel: Callable):
+    """Loss-only counterpart of make_flat_grad_fn for the eval path:
+    no value_and_grad, so eval jaxprs carry no backward ops at all —
+    eval cost and compile time are forward-only by construction, not by
+    hoping XLA DCEs an unused gradient (this matters at GPT2 size)."""
+    def flat_loss(weights_vec, batch, mask):
+        return loss_fn(unravel(weights_vec), batch, mask)
+    return flat_loss
+
+
 def _microbatch_shape(batch_size: int, microbatch_size: int) -> Tuple[int, int]:
     mb = batch_size if microbatch_size <= 0 else min(microbatch_size, batch_size)
     n_mb = -(-batch_size // mb)
@@ -93,8 +103,10 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
     Returns (g, loss, metrics, count): g is the per-mode compressed
     mean-gradient ([D] vector, or [r, c] table for sketch); loss and
     metrics are masked means over the batch; count is the number of
-    valid examples. g is None when compute_grad=False (eval path,
-    fed_worker.py:300-301).
+    valid examples. When compute_grad=False (eval path,
+    fed_worker.py:300-301) g is None and `flat_grad_fn` must be a
+    loss-only callable returning (loss, metrics) — see
+    make_flat_loss_fn — so the traced program has no backward pass.
     """
     B = mask.shape[0]
     n_mb, mb = _microbatch_shape(B, cfg.microbatch_size)
@@ -108,17 +120,17 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
             loss, metrics, grad = flat_grad_fn(weights, b, m)
             accum_grad = accum_grad + grad * count
         else:
-            loss, metrics = jax.lax.stop_gradient(
-                _eval_loss(flat_grad_fn, weights, b, m))
+            loss, metrics = flat_grad_fn(weights, b, m)
         accum_loss = accum_loss + loss * count
         accum_metrics = jax.tree.map(
             lambda a, v: a + v * count, accum_metrics, metrics)
         return (accum_grad, accum_loss, accum_metrics), None
 
     # metric structure probe (abstract eval: shapes only, no FLOPs)
-    _, metrics_shape, _ = jax.eval_shape(
+    probe = jax.eval_shape(
         flat_grad_fn, weights,
         jax.tree.map(lambda x: x[0], mbatch), mmask[0])
+    metrics_shape = probe[1]
     # scan carries seeded from `mask` (not fresh constants) so that
     # under shard_map they inherit the data's varying-axes type
     zero = jnp.zeros_like(mask, shape=())
@@ -177,13 +189,20 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
 
     # per-mode compression (reference fed_worker.py:311-335)
     if cfg.mode == "sketch":
-        sketch = CSVec(d=cfg.grad_size, c=cfg.num_cols, r=cfg.num_rows,
-                       num_blocks=cfg.num_blocks, seed=42)
-        table = sketch.encode(grad)
-        if cfg.max_grad_norm is not None:
-            table = clip_table_to_l2(
-                table, sketch.l2estimate(table), cfg.max_grad_norm)
-        g = table
+        if cfg.defer_sketch_encode:
+            # linearity: the round engine encodes the per-shard client
+            # SUM once, instead of one table per client (Config
+            # property docstring; round.py shard_train)
+            g = grad
+        else:
+            sketch = CSVec(d=cfg.grad_size, c=cfg.num_cols,
+                           r=cfg.num_rows, num_blocks=cfg.num_blocks,
+                           seed=42)
+            table = sketch.encode(grad)
+            if cfg.max_grad_norm is not None:
+                table = clip_table_to_l2(
+                    table, sketch.l2estimate(table), cfg.max_grad_norm)
+            g = table
     else:
         # true_topk / local_topk / fedavg / uncompressed all transmit
         # the dense gradient here; sparsification happens later
@@ -191,12 +210,6 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
         g = grad
 
     return g, loss, metrics, total
-
-
-def _eval_loss(flat_grad_fn, weights, b, m):
-    # reuse the grad fn's closure without differentiating
-    loss, metrics, _ = flat_grad_fn(weights, b, m)
-    return loss, metrics
 
 
 def local_step(flat_grad_fn, weights, batch, mask, error, velocity,

@@ -185,6 +185,13 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             data, mask, err_rows, vel_rows, w_rows, keys)
 
         local_sum = jax.tree.map(lambda t: t.sum(axis=0), results.transmit)
+        if cfg.defer_sketch_encode:
+            # sketch linearity: encode the per-shard client sum ONCE
+            # (clients returned dense gradients; see Config property
+            # docstring). The psum below then moves the [r, c] table —
+            # upload compression on the wire, exactly like the
+            # reference's NCCL reduce of sketch tables.
+            local_sum = fserver.args2sketch(cfg).encode(local_sum)
         transmit = jax.lax.psum(local_sum, "clients")
         total = jax.lax.psum(results.num_examples.sum(), "clients")
         return (transmit, total, results.error, results.velocity,
@@ -311,13 +318,17 @@ def make_eval_fn(loss_fn: fclient.LossFn, unravel: Callable,
                  cfg: Config, mesh: Mesh):
     """Build the jitted eval function — separate from the train factory
     so a distinct val loss (GPT2's nll/acc/ppl metrics,
-    gpt2_train.py:242-253) never builds a throwaway train round."""
-    flat_grad = fclient.make_flat_grad_fn(loss_fn, unravel)
+    gpt2_train.py:242-253) never builds a throwaway train round.
+
+    Uses the loss-only flat fn: the eval jaxpr contains no backward
+    ops (asserted by tests/test_client.py), so eval compiles and runs
+    forward-only instead of relying on XLA to DCE an unused grad."""
+    flat_loss = fclient.make_flat_loss_fn(loss_fn, unravel)
 
     def shard_eval(ps_weights, data, mask):
         def one_shard(b, m):
             _, loss, metrics, count = fclient.forward_grad(
-                flat_grad, ps_weights, b, m, cfg, compute_grad=False)
+                flat_loss, ps_weights, b, m, cfg, compute_grad=False)
             return loss, metrics, count
         return jax.vmap(one_shard)(data, mask)
 

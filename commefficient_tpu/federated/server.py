@@ -118,11 +118,13 @@ def _sketched(sketched_grad, Vvelocity, Verror, cfg: Config, lr, key) -> ServerU
     rho = cfg.virtual_momentum
     sketch = args2sketch(cfg)
 
+    # error_type is "virtual" or "none" here: Config.validate()
+    # rejects sketch+local outright, as the reference's own workers do
+    # (fed_worker.py:221-222 asserts it away — the server-side alias at
+    # fed_aggregator.py:579-580 is unreachable there too, so there is
+    # no local-error branch to carry).
     Vvelocity = sketched_grad + rho * Vvelocity
-    if cfg.error_type == "local":
-        # reference aliases Verror to the velocity table (:579-580)
-        decode_table = Vvelocity
-    elif cfg.error_type == "virtual":
+    if cfg.error_type == "virtual":
         Verror = Verror + Vvelocity
         decode_table = Verror
     else:  # "none": decode straight from the momentum table.
@@ -145,8 +147,5 @@ def _sketched(sketched_grad, Vvelocity, Verror, cfg: Config, lr, key) -> ServerU
     if cfg.error_type == "virtual":
         Verror = Verror * not_sent
     Vvelocity = Vvelocity * not_sent
-    if cfg.error_type == "local":
-        # alias semantics: masking velocity also masked the error table
-        Verror = Vvelocity
 
     return ServerUpdate(update * lr, Vvelocity, Verror, None)

@@ -221,6 +221,28 @@ def resize_token_embeddings(params, new_vocab_size: int,
     return params
 
 
+def resize_position_embeddings(params, new_n_positions: int,
+                               key: Optional[jax.Array] = None,
+                               initializer_range: float = 0.02):
+    """Grow the position embedding to cover a longer corpus, returning
+    new params. Needed when a saved artifact (whose n_positions rides
+    along in config.json) is loaded against a corpus padded longer than
+    the one it was trained on — without this, out-of-range position ids
+    silently clamp to the last row under jit."""
+    params = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    wpe = params["params"]["transformer"]["wpe"]["embedding"]
+    old_n, E = wpe.shape
+    if new_n_positions <= old_n:
+        return params
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    new_rows = jax.random.normal(
+        key, (new_n_positions - old_n, E), wpe.dtype) * initializer_range
+    params["params"]["transformer"]["wpe"]["embedding"] = jnp.concatenate(
+        [wpe, new_rows], axis=0)
+    return params
+
+
 # ---- pretrained-weight import (local HF torch checkpoints) --------------
 
 def params_from_hf_state_dict(state_dict: Dict[str, Any],
@@ -272,15 +294,150 @@ def params_from_hf_state_dict(state_dict: Dict[str, Any],
             },
         }
     E = cfg.n_embd
-    if key is None:
-        key = jax.random.PRNGKey(0)
-    mc_kernel = (jax.random.normal(key, (E, 1), jnp.float32)
-                 * cfg.initializer_range)
+    # MC head: present in double-heads checkpoints (HF names it
+    # `multiple_choice_head.summary`, a torch Linear with [out, in]
+    # weights — transpose into the Dense kernel layout); LM-only
+    # checkpoints get a fresh N(0, initializer_range) kernel
+    mc_name = "multiple_choice_head.summary."
+    if mc_name + "weight" in state_dict:
+        mc_kernel = t(mc_name + "weight").T            # [1, E] -> [E, 1]
+        mc_bias = t(mc_name + "bias")
+    else:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        mc_kernel = (jax.random.normal(key, (E, 1), jnp.float32)
+                     * cfg.initializer_range)
+        mc_bias = jnp.zeros((1,), jnp.float32)
     return {"params": {
         "transformer": tr,
-        "mc_head": {"kernel": mc_kernel,
-                    "bias": jnp.zeros((1,), jnp.float32)},
+        "mc_head": {"kernel": mc_kernel, "bias": mc_bias},
     }}
+
+
+def hf_state_dict_from_params(params, cfg: GPT2Config) -> Dict[str, np.ndarray]:
+    """Inverse of params_from_hf_state_dict: emit a HuggingFace
+    GPT2DoubleHeadsModel-style state dict (numpy values). Projection
+    kernels keep the Conv1D [in, out] layout; the MC head transposes
+    back to torch Linear [out, in]; `lm_head.weight` aliases the tied
+    token embedding, as HF serializes it."""
+    def a(x):
+        return np.asarray(x)
+
+    p = params["params"]
+    tr = p["transformer"]
+    sd: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": a(tr["wte"]["embedding"]),
+        "transformer.wpe.weight": a(tr["wpe"]["embedding"]),
+        "transformer.ln_f.weight": a(tr["ln_f"]["scale"]),
+        "transformer.ln_f.bias": a(tr["ln_f"]["bias"]),
+        "lm_head.weight": a(tr["wte"]["embedding"]),
+        "multiple_choice_head.summary.weight": a(p["mc_head"]["kernel"]).T,
+        "multiple_choice_head.summary.bias": a(p["mc_head"]["bias"]),
+    }
+    for i in range(cfg.n_layer):
+        b = tr[f"h_{i}"]
+        pre = f"transformer.h.{i}."
+        sd[pre + "ln_1.weight"] = a(b["ln_1"]["scale"])
+        sd[pre + "ln_1.bias"] = a(b["ln_1"]["bias"])
+        sd[pre + "ln_2.weight"] = a(b["ln_2"]["scale"])
+        sd[pre + "ln_2.bias"] = a(b["ln_2"]["bias"])
+        sd[pre + "attn.c_attn.weight"] = a(b["attn"]["c_attn"]["kernel"])
+        sd[pre + "attn.c_attn.bias"] = a(b["attn"]["c_attn"]["bias"])
+        sd[pre + "attn.c_proj.weight"] = a(b["attn"]["c_proj"]["kernel"])
+        sd[pre + "attn.c_proj.bias"] = a(b["attn"]["c_proj"]["bias"])
+        sd[pre + "mlp.c_fc.weight"] = a(b["mlp"]["c_fc"]["kernel"])
+        sd[pre + "mlp.c_fc.bias"] = a(b["mlp"]["c_fc"]["bias"])
+        sd[pre + "mlp.c_proj.weight"] = a(b["mlp"]["c_proj"]["kernel"])
+        sd[pre + "mlp.c_proj.bias"] = a(b["mlp"]["c_proj"]["bias"])
+    return sd
+
+
+def save_pretrained(log_dir: str, params, cfg: GPT2Config,
+                    tokenizer=None) -> str:
+    """HF-style final artifact (the reference saves tokenizer + config
+    into log_dir at startup, gpt2_train.py:275-283, and the finetuned
+    weights via model.save_pretrained(log_dir) at teardown,
+    fed_aggregator.py:208-211): writes `pytorch_model.bin` (torch state
+    dict in HF double-heads naming), `config.json`, and the tokenizer's
+    own files when it can save itself. The directory round-trips
+    through `load_pretrained_dir` and — for the transformer weights —
+    through stock `transformers` `from_pretrained`."""
+    import json
+    import os
+
+    os.makedirs(log_dir, exist_ok=True)
+    hf_sd = hf_state_dict_from_params(params, cfg)
+    try:
+        import torch
+        sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+              for k, v in hf_sd.items()}
+        torch.save(sd, os.path.join(log_dir, "pytorch_model.bin"))
+    except ImportError:
+        # torch-less environment: same state dict, npz container (the
+        # artifact still round-trips through load_pretrained_dir; only
+        # stock-transformers interop needs the .bin)
+        np.savez(os.path.join(log_dir, "pytorch_model.npz"), **hf_sd)
+    conf = {
+        "model_type": "gpt2",
+        "architectures": ["GPT2DoubleHeadsModel"],
+        "vocab_size": cfg.vocab_size,
+        "n_positions": cfg.n_positions,
+        "n_ctx": cfg.n_positions,
+        "n_embd": cfg.n_embd,
+        "n_layer": cfg.n_layer,
+        "n_head": cfg.n_head,
+        "layer_norm_epsilon": cfg.layer_norm_epsilon,
+        "initializer_range": cfg.initializer_range,
+    }
+    with open(os.path.join(log_dir, "config.json"), "w") as f:
+        json.dump(conf, f, indent=1)
+    if tokenizer is not None:
+        inner = getattr(tokenizer, "tok", tokenizer)
+        if hasattr(inner, "save_pretrained"):
+            inner.save_pretrained(log_dir)
+        else:
+            # offline HashTokenizer: record enough to rebuild it
+            with open(os.path.join(log_dir, "tokenizer_config.json"),
+                      "w") as f:
+                json.dump({"tokenizer_class": "HashTokenizer",
+                           "vocab_size": len(tokenizer)}, f)
+    return log_dir
+
+
+def load_pretrained_dir(path: str,
+                        key: Optional[jax.Array] = None
+                        ) -> Optional[Tuple[dict, GPT2Config]]:
+    """Load a `save_pretrained` artifact directly — config.json +
+    pytorch_model.bin — without instantiating a transformers model (the
+    double-heads class differs across transformers versions; the state
+    dict doesn't). Returns (params, cfg) or None if `path` is not such
+    a directory."""
+    import json
+    import os
+
+    cfg_path = os.path.join(path, "config.json")
+    bin_path = os.path.join(path, "pytorch_model.bin")
+    npz_path = os.path.join(path, "pytorch_model.npz")
+    if not os.path.isfile(cfg_path):
+        return None
+    if os.path.isfile(bin_path):
+        import torch
+        sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+    elif os.path.isfile(npz_path):
+        sd = dict(np.load(npz_path))
+    else:
+        return None
+
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    cfg = GPT2Config(
+        vocab_size=raw["vocab_size"],
+        n_positions=raw.get("n_positions", 1024),
+        n_embd=raw["n_embd"], n_layer=raw["n_layer"],
+        n_head=raw["n_head"],
+        layer_norm_epsilon=raw.get("layer_norm_epsilon", 1e-5),
+        initializer_range=raw.get("initializer_range", 0.02))
+    return params_from_hf_state_dict(sd, cfg, key=key), cfg
 
 
 def try_load_pretrained(model_checkpoint: str, cfg: GPT2Config,

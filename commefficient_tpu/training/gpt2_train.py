@@ -34,10 +34,11 @@ from commefficient_tpu.data.persona import (
 )
 from commefficient_tpu.federated.api import FedModel, FedOptimizer
 from commefficient_tpu.models.gpt2 import (
-    GPT2Config, GPT2DoubleHeads, PRESETS, build_gpt2,
-    resize_token_embeddings, try_load_pretrained,
+    GPT2Config, GPT2DoubleHeads, PRESETS, build_gpt2, load_pretrained_dir,
+    resize_position_embeddings, resize_token_embeddings, save_pretrained,
+    try_load_pretrained,
 )
-from commefficient_tpu.utils.checkpoint import save_checkpoint
+from commefficient_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from commefficient_tpu.utils.logging import TableLogger, Timer, make_logdir
 from commefficient_tpu.utils.schedules import LambdaLR, PiecewiseLinear
 
@@ -144,6 +145,7 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
     spe = train_loader.steps_per_epoch
     epoch_download = epoch_upload = 0.0
     batch_idx = 0
+    ckpt_path = os.path.join(cfg.checkpoint_path, "gpt2")
 
     if cfg.do_profile:
         jax.profiler.start_trace(os.path.join(log_dir or ".", "profile"))
@@ -182,6 +184,15 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
             jax.profiler.stop_trace()
             print(f"profile trace written to "
                   f"{os.path.join(log_dir or '.', 'profile')}")
+        # mid-run checkpoint so --resume has something to pick up when
+        # the run is killed (symmetric with cv_train.py's per-epoch
+        # save; the resume-read half alone would be unreachable)
+        if cfg.checkpoint_every and epoch % cfg.checkpoint_every == 0:
+            save_checkpoint(ckpt_path, model.server, model.clients,
+                            scheduler_step=lr_scheduler.step_count,
+                            accountant=model.accountant,
+                            prev_change_words=model._prev_change_words)
+            print(f"checkpointed to {ckpt_path}")
 
     n_clients = model.num_clients
     print(f"Total Download (MiB): {epoch_download:0.2f} (only epoch 1)")
@@ -205,20 +216,52 @@ def test_gpt2(model: FedModel, val_loader, timer: Optional[Timer] = None,
 
 # ---------------- main (reference train(), gpt2_train.py:255-313) --------
 
-def build_model_and_params(cfg: Config, tokenizer, seq_len: int):
+def build_model_and_params(cfg: Config, tokenizer, seq_len: int,
+                           source: Optional[str] = None,
+                           require_load: bool = False):
     """Build the Flax GPT2 sized for the tokenizer + corpus; import
-    local pretrained weights when available, otherwise random init."""
+    weights from `source` — a save_pretrained artifact directory (the
+    --finetune path), a local HF checkpoint, or a preset name — with
+    random init as the fallback. require_load=True turns the fallback
+    into an error (the --finetune contract: evaluating a fresh init as
+    if it were the finetuned model would silently report garbage; the
+    reference fails inside from_pretrained the same way)."""
     vocab = len(tokenizer)
     key = jax.random.PRNGKey(cfg.seed)
-    if cfg.do_test:
+    source = source or cfg.model_checkpoint
+
+    loaded = load_pretrained_dir(source, key=key)
+    if loaded is not None:
+        # our own HF-style artifact: config rides along, any scale
+        # (incl. the tiny --test model a smoke run saved). Widen the
+        # position table if this corpus pads longer than the artifact's
+        # (same hazard the other branches handle via max(., seq_len))
+        pretrained, gcfg = loaded
+        if seq_len > gcfg.n_positions:
+            pretrained = resize_position_embeddings(
+                pretrained, seq_len, key=key,
+                initializer_range=gcfg.initializer_range)
+            gcfg = gcfg.replace(n_positions=seq_len)
+    elif require_load:
+        # finetune_path may also name a stock HF checkpoint directory
+        # (the reference hands it straight to from_pretrained)
+        gcfg = PRESETS["gpt2"].replace(
+            n_positions=max(PRESETS["gpt2"].n_positions, seq_len))
+        pretrained = try_load_pretrained(source, gcfg, key=key)
+        if pretrained is None:
+            raise FileNotFoundError(
+                f"--finetune: no loadable artifact at {source!r} "
+                "(expected config.json + pytorch_model.bin/.npz from a "
+                "previous run's save_pretrained, or a local HF "
+                "checkpoint)")
+    elif cfg.do_test:
         gcfg = GPT2Config(vocab_size=vocab, n_positions=max(seq_len, 8),
                           n_embd=32, n_layer=2, n_head=2)
         pretrained = None
     else:
-        base = PRESETS.get(cfg.model_checkpoint, PRESETS["gpt2"])
+        base = PRESETS.get(source, PRESETS["gpt2"])
         gcfg = base.replace(n_positions=max(base.n_positions, seq_len))
-        pretrained = try_load_pretrained(cfg.model_checkpoint, gcfg,
-                                         key=key)
+        pretrained = try_load_pretrained(source, gcfg, key=key)
         if pretrained is None:
             # from-scratch: size the embedding directly for the
             # tokenizer (no resize step needed)
@@ -261,7 +304,21 @@ def main(argv=None) -> bool:
     seq_len = max(train_loader.dataset.seq_len,
                   val_loader.dataset.seq_len)
 
-    module, params = build_model_and_params(cfg, tokenizer, seq_len)
+    # --finetune redirects the model source to the finetuned artifact
+    # (reference swaps model_checkpoint = finetune_path,
+    # gpt2_train.py:270-272; it skips the swap under --test because its
+    # finetune_path then names a full HF checkpoint — here a --test
+    # smoke SAVES a loadable tiny artifact, so honor one when present)
+    source = cfg.model_checkpoint
+    if cfg.do_finetune and (
+            not cfg.do_test
+            or any(os.path.isfile(os.path.join(cfg.finetune_path, f))
+                   for f in ("pytorch_model.bin", "pytorch_model.npz"))):
+        source = cfg.finetune_path
+
+    module, params = build_model_and_params(
+        cfg, tokenizer, seq_len, source=source,
+        require_load=(source == cfg.finetune_path and cfg.do_finetune))
 
     model = FedModel(None, make_compute_loss_train(module, cfg), cfg,
                      loss_val=make_compute_loss_val(module), params=params,
@@ -273,6 +330,21 @@ def main(argv=None) -> bool:
     schedule = PiecewiseLinear([0, cfg.num_epochs * spe],
                                [cfg.lr_scale, 0.0])
     lr_scheduler = LambdaLR(opt, lr_lambda=schedule)
+
+    # mid-run resume, symmetric with cv_train.main (cv_train.py:340-353)
+    ckpt_path = os.path.join(cfg.checkpoint_path, "gpt2")
+    if cfg.resume and os.path.exists(ckpt_path + ".npz"):
+        ckpt = load_checkpoint(ckpt_path)
+        model.server = ckpt.server
+        if ckpt.clients is not None:
+            model.clients = ckpt.clients
+        if ckpt.accountant_state:
+            model.accountant.load_state_dict(ckpt.accountant_state)
+        if ckpt.prev_change_words is not None:
+            model._prev_change_words = ckpt.prev_change_words
+        lr_scheduler.load_state_dict({"step_count": ckpt.scheduler_step})
+        print(f"resumed from {ckpt_path} at round "
+              f"{int(ckpt.server.round_idx)}")
 
     log_dir = make_logdir(cfg)
     print(f"Finished initializing in {timer():.2f} seconds")
@@ -286,6 +358,15 @@ def main(argv=None) -> bool:
                         log_dir=log_dir)
         save_checkpoint(os.path.join(log_dir, "gpt2"), model.server,
                         scheduler_step=lr_scheduler.step_count)
+        if cfg.do_checkpoint:
+            save_checkpoint(ckpt_path, model.server, model.clients,
+                            scheduler_step=lr_scheduler.step_count,
+                            accountant=model.accountant,
+                            prev_change_words=model._prev_change_words)
+        # HF-style final artifact: tokenizer + config + weights
+        # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
+        save_pretrained(log_dir, model.state_dict(), module.cfg,
+                        tokenizer)
         test_gpt2(model, val_loader, timer=timer)
     model.finalize()
     return ok
