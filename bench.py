@@ -321,16 +321,18 @@ def _worker_main() -> int:
         return 3
 
 
-def _run_child(extra_env, timeout_s):
+def _run_child(extra_env, timeout_s, script=None):
     """Run the measurement in a child process; returns the parsed JSON
     line or None. A hard kill-on-timeout is the only watchdog that
-    works when the TPU tunnel hangs inside C++."""
+    works when the TPU tunnel hangs inside C++. `script` defaults to
+    this file; benchmarks/bench_gpt2.py reuses the machinery on its
+    own file."""
     env = {**os.environ, "BENCH_IS_WORKER": "1",
            "BENCH_CHILD_BUDGET": str(max(timeout_s - 60, 30)),
            **extra_env}
     try:
         r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
+            [sys.executable, script or os.path.abspath(__file__)],
             capture_output=True, text=True, timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
         log(f"child timed out after {timeout_s}s ({extra_env})")

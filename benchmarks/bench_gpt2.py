@@ -1,0 +1,249 @@
+"""Benchmark: wall-clock per federated round at GPT2 scale.
+
+BASELINE config #5: GPT2-small double-heads (124M params) on
+PersonaChat-shaped data, count-sketch compression + virtual momentum.
+This is the regime where MFU stops being dominated by round overhead
+(VERDICT r2 next #3): the transformer fwd/bwd is ~0.5 TFLOP/round at
+the shapes below, vs ResNet9/CIFAR's 0.05.
+
+Same measurement discipline as the repo-root bench.py (whose
+machinery this reuses): the measurement runs in a CHILD process under
+a hard kill-on-timeout (bench._run_child on this file — SIGALRM alone
+cannot interrupt a TPU tunnel hung inside C++), backend retry with CPU
+degrade, ONE jitted scalar digest per measurement so the axon tunnel's
+~70 ms/transfer sync cost and XLA DCE cannot distort the number,
+analytic reference stand-in = num_workers x a measured single-client
+serialized fwd/bwd on the same chip (the reference serializes clients
+per GPU, fed_worker.py:60).
+
+Writes one JSON line to stdout:
+  {"metric": "persona_gpt2s_sketch_round_time", "value": .., ...}
+
+Usage:  python benchmarks/bench_gpt2.py                (TPU if up)
+        JAX_PLATFORMS=cpu GPT2_BENCH_SMALL=1 python benchmarks/bench_gpt2.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # repo-root harness: log/alarm_guard/acquire_backend/PEAK_TFLOPS
+
+NUM_WORKERS = int(os.environ.get("GPT2_BENCH_WORKERS", "4"))
+LOCAL_BATCH = int(os.environ.get("GPT2_BENCH_BATCH", "4"))
+ROUNDS = int(os.environ.get("GPT2_BENCH_ROUNDS", "4"))
+SEQ_LEN = int(os.environ.get("GPT2_BENCH_SEQ", "128"))
+CANDS = 2
+SMALL = os.environ.get("GPT2_BENCH_SMALL", "") == "1"
+STAGE_TIMEOUT = int(os.environ.get("BENCH_STAGE_TIMEOUT", "900"))
+
+
+def main() -> int:
+    jax, platform = bench.acquire_backend()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.federated import round as fround
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.ops.flat import flatten_params
+    from commefficient_tpu.parallel.mesh import make_client_mesh
+    from commefficient_tpu.training.gpt2_train import (
+        make_compute_loss_train,
+    )
+
+    device_kind = jax.devices()[0].device_kind
+    mesh = make_client_mesh(min(len(jax.devices()), NUM_WORKERS))
+
+    small = SMALL or platform == "cpu"
+    if small:
+        gcfg = GPT2Config(vocab_size=5005, n_positions=max(SEQ_LEN, 64),
+                          n_embd=64, n_layer=2, n_head=2)
+    else:
+        # GPT2-small sized for the PersonaChat tokenizer (50257 + 5
+        # special tokens, data/persona.py)
+        gcfg = GPT2Config(vocab_size=50262,
+                          n_positions=max(SEQ_LEN, 128))
+    module = GPT2DoubleHeads(gcfg)
+
+    key = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((1, CANDS, SEQ_LEN), jnp.int32)
+    params = module.init(key, x0, x0, jnp.zeros((1, CANDS), jnp.int32))
+    vec, unravel = flatten_params(params)
+    D = int(vec.shape[0])
+    bench.log(f"gpt2 bench D={D} small={small} rounds={ROUNDS} "
+              f"W={NUM_WORKERS} B={LOCAL_BATCH} L={SEQ_LEN}")
+
+    cfg = Config(
+        mode="sketch",
+        # the reference flagship geometry RATIOS scaled to this D
+        # (utils.py:142-145 is 5 x 500k at D=6.6M -> ~13 coords/cell)
+        k=max(D // 130, 1000),
+        num_rows=5,
+        num_cols=max(D // 13, 10_000),
+        num_blocks=20, error_type="virtual", virtual_momentum=0.9,
+        local_momentum=0.0, weight_decay=0.0, microbatch_size=-1,
+        num_workers=NUM_WORKERS, num_clients=10 * NUM_WORKERS,
+        grad_size=D, lm_coef=1.0, mc_coef=1.0,
+    ).validate()
+
+    loss_fn = make_compute_loss_train(module, cfg)
+
+    train_round = fround.make_train_fn(loss_fn, unravel, cfg, mesh)
+    server = fround.init_server_state(cfg, vec)
+    clients = fround.init_client_state(cfg, cfg.resolved_num_clients(),
+                                       vec, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    V = gcfg.vocab_size
+
+    def tok(shape, hi):
+        return jnp.asarray(rng.randint(0, hi, shape).astype(np.int32))
+
+    W, B = NUM_WORKERS, LOCAL_BATCH
+    input_ids = tok((W, B, CANDS, SEQ_LEN), V)
+    mc_token_ids = tok((W, B, CANDS), SEQ_LEN)
+    lm_labels = tok((W, B, CANDS, SEQ_LEN), V)
+    mc_labels = tok((W, B), CANDS)
+    token_type_ids = tok((W, B, CANDS, SEQ_LEN), V)
+    data = (input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids)
+    mask = jnp.ones((W, B), jnp.float32)
+
+    batches = fround.RoundBatch(
+        jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (ROUNDS, W)),
+        tuple(jnp.broadcast_to(d, (ROUNDS,) + d.shape) for d in data),
+        jnp.broadcast_to(mask, (ROUNDS, W, B)))
+    lrs = jnp.full((ROUNDS,), 4e-2)
+    run = train_round.train_rounds
+
+    @jax.jit
+    def run_digest(server, clients, batches, lrs, key):
+        server2, clients2, m, bits = run(server, clients, batches, lrs,
+                                         key)
+        leaves = [l for l in jax.tree.leaves(clients2) if l.size > 0]
+        client_digest = sum([l.reshape(-1)[0] for l in leaves],
+                            jnp.float32(0))
+        return (m.losses.mean() + server2.ps_weights[0]
+                + bits.sum(dtype=jnp.uint32).astype(jnp.float32)
+                + client_digest)
+
+    t0 = time.time()
+    with bench.alarm_guard(STAGE_TIMEOUT, "compile+first run"):
+        float(np.asarray(run_digest(server, clients, batches, lrs, key)))
+    bench.log(f"compile+first run: {time.time() - t0:.1f}s")
+
+    flops_per_round = None
+    try:
+        with bench.alarm_guard(STAGE_TIMEOUT, "cost analysis"):
+            lowered = run_digest.lower(server, clients, batches, lrs, key)
+            cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        if cost and "flops" in cost:
+            flops_per_round = float(cost["flops"]) / ROUNDS
+    except Exception as e:
+        bench.log(f"cost_analysis unavailable: {e}")
+
+    with bench.alarm_guard(STAGE_TIMEOUT, "measure"):
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(np.asarray(run_digest(server, clients, batches, lrs,
+                                        key)))
+            reps.append(time.perf_counter() - t0)
+        round_ms = float(np.median(reps)) / ROUNDS * 1e3
+
+    # analytic reference stand-in: per-client serialized fwd/bwd
+    def one_client_step(params_vec, d):
+        def loss(v):
+            l, _ = loss_fn(unravel(v),
+                           tuple(x[0] for x in d), mask[0])
+            return l
+        return jax.grad(loss)(params_vec)
+
+    @jax.jit
+    def serial_steps(params_vec, d):
+        def body(v, _):
+            return v - 1e-6 * one_client_step(v, d), None
+        v, _ = jax.lax.scan(body, params_vec, None, length=ROUNDS)
+        return v.sum()
+
+    with bench.alarm_guard(STAGE_TIMEOUT, "baseline measure"):
+        float(np.asarray(serial_steps(vec, data)))
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(np.asarray(serial_steps(vec, data)))
+            reps.append(time.perf_counter() - t0)
+        ref_round_ms = (float(np.median(reps)) / ROUNDS * 1e3
+                        * NUM_WORKERS)
+
+    out = {
+        "metric": "persona_gpt2s_sketch_round_time",
+        "value": round(round_ms, 3),
+        "unit": "ms/round",
+        "vs_baseline": round(ref_round_ms / round_ms, 3),
+        "platform": platform,
+        "device_kind": device_kind,
+        "num_workers": NUM_WORKERS,
+        "local_batch": LOCAL_BATCH,
+        "seq_len": SEQ_LEN,
+        "num_candidates": CANDS,
+        "grad_size": D,
+    }
+    if flops_per_round:
+        tflops_per_s = flops_per_round / (round_ms / 1e3) / 1e12
+        out["flops_per_round"] = flops_per_round
+        out["tflops_per_s"] = round(tflops_per_s, 3)
+        peak = next((v for k, v in bench.PEAK_TFLOPS.items()
+                     if k.lower() in device_kind.lower()), None)
+        if peak:
+            out["mfu"] = round(tflops_per_s / peak, 4)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def orchestrate() -> int:
+    """Parent: run main() in a hard-killed child, degrading to a CPU
+    child (small geometry) if the TPU child dies or times out."""
+    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
+    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+    me = os.path.abspath(__file__)
+
+    out = None
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        out = bench._run_child({}, tpu_timeout, script=me)
+        if out is not None and out.get("platform") == "cpu":
+            bench.log("TPU child self-degraded to CPU")
+    if out is None:
+        bench.log("falling back to a CPU child (small geometry)")
+        out = bench._run_child(
+            {"JAX_PLATFORMS": "cpu", "GPT2_BENCH_SMALL": "1",
+             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform"
+                             "_device_count=8").strip()},
+            cpu_timeout, script=me)
+    if out is None:
+        out = {"metric": "persona_gpt2s_sketch_round_time",
+               "value": None, "unit": "ms/round", "vs_baseline": None,
+               "error": "all bench children failed or timed out"}
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if os.environ.get("BENCH_IS_WORKER") == "1":
+        budget = os.environ.get("BENCH_CHILD_BUDGET")
+        if budget:
+            # alarm_guard clamps every stage to this child-wide budget
+            bench._DEADLINE = time.time() + int(budget)
+        try:
+            raise SystemExit(main())
+        except bench.StageTimeout as e:
+            bench.log(f"FATAL: stage timed out: {e}")
+            raise SystemExit(3)
+    raise SystemExit(orchestrate())

@@ -104,6 +104,11 @@ class Config:
     scan_span: int = 0
     num_clients: Optional[int] = None
     num_workers: int = 1
+    # tensor-parallel degree over the mesh's `model` axis (an extension
+    # beyond the reference, whose only parallelism is one worker
+    # process per GPU): >1 lays devices out as (clients, model) and
+    # GSPMD-partitions each client's fwd/bwd per parallel/tp.py
+    model_parallel: int = 1
     # cap on the static per-client batch dim when local_batch_size=-1
     # (whole-client batches). Uncapped, fedavg at ImageNet scale stages
     # max(data_per_client) examples per client slot (~2.4 GB f32 at
@@ -291,6 +296,9 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                    help="run each epoch as one scanned device program")
     p.add_argument("--scan_span", type=int, default=0,
                    help="flush scanned rounds every N rounds (0=epoch)")
+    p.add_argument("--model_parallel", type=int, default=1,
+                   help="tensor-parallel degree over the mesh's model "
+                        "axis (GPT2-scale models; parallel/tp.py)")
     p.add_argument("--iid", action="store_true", dest="do_iid")
     p.add_argument("--train_dataloader_workers", type=int, default=0)
     p.add_argument("--val_dataloader_workers", type=int, default=0)

@@ -87,9 +87,17 @@ def init_client_state(cfg: Config, num_clients: int,
                       mesh: Optional[Mesh] = None) -> ClientState:
     """Allocate per-client state rows (sharded over the mesh's clients
     axis when a mesh is given, since at 17K+ clients these arrays are
-    the memory hazard — SURVEY.md §7.0)."""
+    the memory hazard — SURVEY.md §7.0).
+
+    The row count is padded up to a multiple of the mesh axis so any
+    num_clients shards (e.g. CIFAR's 10 natural clients on an 8-device
+    mesh, which the reference handles with 8 GPU workers too). Padding
+    rows are inert: the round engine gathers/scatters participant rows
+    by client id, and ids are always < the true num_clients."""
     D = cfg.grad_size
     empty = jnp.zeros((0,), jnp.float32)
+    n = mesh.shape["clients"] if mesh is not None else 1
+    rows = -(-num_clients // n) * n
 
     def alloc(shape):
         arr = jnp.zeros(shape, jnp.float32)
@@ -98,12 +106,12 @@ def init_client_state(cfg: Config, num_clients: int,
                 arr, NamedSharding(mesh, P("clients", None)))
         return arr
 
-    errors = alloc((num_clients, D)) if cfg.error_type == "local" else empty
-    velocities = (alloc((num_clients, D)) if cfg.local_momentum > 0
+    errors = alloc((rows, D)) if cfg.error_type == "local" else empty
+    velocities = (alloc((rows, D)) if cfg.local_momentum > 0
                   else empty)
     if cfg.do_topk_down:
         assert ps_weights is not None
-        weights = jnp.broadcast_to(ps_weights, (num_clients, D)).copy()
+        weights = jnp.broadcast_to(ps_weights, (rows, D)).copy()
         if mesh is not None:
             weights = jax.device_put(
                 weights, NamedSharding(mesh, P("clients", None)))
@@ -144,7 +152,9 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
     flat_grad = fclient.make_flat_grad_fn(loss_fn, unravel)
     if grad_mask is not None:
         grad_mask = jnp.asarray(grad_mask, jnp.float32)
-    n_shards = mesh.devices.size
+    # clients sharded over the `clients` axis only — further axes
+    # (tensor-parallel `model`) don't divide the client population
+    n_shards = mesh.shape["clients"]
 
     # ---------------- per-shard client phase ----------------------------
     def shard_train(ps_weights, data, mask, err_rows, vel_rows, w_rows,
@@ -206,6 +216,11 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                   P("clients"), P("clients"), P("clients"), P()),
         out_specs=(P(), P(), state_spec, state_spec, state_spec,
                    P("clients"), P("clients"), P("clients")),
+        # manual only over `clients`; any further mesh axes (`model`
+        # for tensor parallelism) stay AUTO — GSPMD partitions the
+        # client computation over them, steered by the workload's
+        # with_sharding_constraint calls (parallel/tp.py)
+        axis_names=frozenset({"clients"}),
     )
 
     # ---------------- full train round ----------------------------------
@@ -336,6 +351,8 @@ def make_eval_fn(loss_fn: fclient.LossFn, unravel: Callable,
         shard_eval, mesh=mesh,
         in_specs=(P(), P("clients"), P("clients")),
         out_specs=(P("clients"), P("clients"), P("clients")),
+        # model axis (if present) stays auto — see make_train_fn
+        axis_names=frozenset({"clients"}),
     )
 
     @jax.jit

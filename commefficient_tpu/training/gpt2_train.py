@@ -38,6 +38,8 @@ from commefficient_tpu.models.gpt2 import (
     resize_position_embeddings, resize_token_embeddings, save_pretrained,
     try_load_pretrained,
 )
+from commefficient_tpu.parallel.mesh import make_client_model_mesh
+from commefficient_tpu.parallel.tp import tp_loss
 from commefficient_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from commefficient_tpu.utils.logging import TableLogger, Timer, make_logdir
 from commefficient_tpu.utils.schedules import LambdaLR, PiecewiseLinear
@@ -320,8 +322,22 @@ def main(argv=None) -> bool:
         cfg, tokenizer, seq_len, source=source,
         require_load=(source == cfg.finetune_path and cfg.do_finetune))
 
-    model = FedModel(None, make_compute_loss_train(module, cfg), cfg,
-                     loss_val=make_compute_loss_val(module), params=params,
+    loss_train = make_compute_loss_train(module, cfg)
+    loss_val = make_compute_loss_val(module)
+    mesh = None
+    if cfg.model_parallel > 1:
+        # (clients, model) mesh: manual DP over clients, GSPMD tensor
+        # parallelism over the model axis (parallel/tp.py)
+        shards = max(len(jax.devices()) // cfg.model_parallel, 1)
+        while cfg.num_workers % shards:
+            shards -= 1
+        mesh = make_client_model_mesh(shards, cfg.model_parallel)
+        loss_train = tp_loss(loss_train, mesh)
+        loss_val = tp_loss(loss_val, mesh)
+        print(f"tensor parallel: mesh {dict(mesh.shape)}")
+
+    model = FedModel(None, loss_train, cfg, loss_val=loss_val,
+                     params=params, mesh=mesh,
                      num_clients=train_loader.dataset.num_clients)
     opt = FedOptimizer(model)
 

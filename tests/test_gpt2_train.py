@@ -82,6 +82,13 @@ def test_finetune_roundtrip(tmp_path):
     np.testing.assert_allclose(got, want)
 
 
+def test_smoke_tensor_parallel(tmp_path):
+    """--model_parallel 2 runs the same driver on a (clients, model)
+    mesh (4x2 on the 8-device CPU test mesh)."""
+    assert run_main(tmp_path, "--mode", "uncompressed",
+                    "--model_parallel", "2")
+
+
 def test_checkpoint_and_resume(tmp_path):
     ck = str(tmp_path / "ck")
     assert run_main(tmp_path, "--mode", "uncompressed",
