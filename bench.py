@@ -143,6 +143,11 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from commefficient_tpu.utils.cache import (
+        enable_persistent_compilation_cache,
+    )
+    enable_persistent_compilation_cache()
+
     from commefficient_tpu.config import Config
     from commefficient_tpu.federated import round as fround
     from commefficient_tpu.models import ResNet9

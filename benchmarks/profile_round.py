@@ -38,6 +38,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from commefficient_tpu.config import Config
+from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
+
+enable_persistent_compilation_cache()
 from commefficient_tpu.federated import round as fround
 from commefficient_tpu.models import ResNet9
 from commefficient_tpu.ops.flat import flatten_params, masked_topk

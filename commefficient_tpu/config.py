@@ -109,6 +109,10 @@ class Config:
     # process per GPU): >1 lays devices out as (clients, model) and
     # GSPMD-partitions each client's fwd/bwd per parallel/tp.py
     model_parallel: int = 1
+    # run client forward/backward in bfloat16 (f32 master weights and
+    # f32 server/compression state; see client.make_flat_grad_fn) —
+    # the MXU's fast path, an extension over the reference's fp32 CUDA
+    do_bf16: bool = False
     # cap on the static per-client batch dim when local_batch_size=-1
     # (whole-client batches). Uncapped, fedavg at ImageNet scale stages
     # max(data_per_client) examples per client slot (~2.4 GB f32 at
@@ -299,6 +303,8 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--model_parallel", type=int, default=1,
                    help="tensor-parallel degree over the mesh's model "
                         "axis (GPT2-scale models; parallel/tp.py)")
+    p.add_argument("--bf16", action="store_true", dest="do_bf16",
+                   help="bfloat16 client fwd/bwd (f32 master weights)")
     p.add_argument("--iid", action="store_true", dest="do_iid")
     p.add_argument("--train_dataloader_workers", type=int, default=0)
     p.add_argument("--val_dataloader_workers", type=int, default=0)

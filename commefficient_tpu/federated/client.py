@@ -48,27 +48,55 @@ class ClientResult(NamedTuple):
     num_examples: jax.Array      # valid example count (f32)
 
 
-def make_flat_grad_fn(loss_fn: LossFn, unravel: Callable):
+def _cast_tree(tree, dtype):
+    """Cast every inexact leaf to `dtype` (ints/bools untouched)."""
+    return jax.tree.map(
+        lambda l: l.astype(dtype)
+        if jnp.issubdtype(l.dtype, jnp.inexact) else l, tree)
+
+
+def make_flat_grad_fn(loss_fn: LossFn, unravel: Callable,
+                      compute_dtype=None):
     """Lift a pytree loss into flat-vector space: the substrate every
     compression op works in (replaces get_grad/get_grad_vec,
-    reference utils.py:254-273)."""
+    reference utils.py:254-273).
+
+    compute_dtype=jnp.bfloat16 runs the client forward/backward on the
+    MXU's fast path: master weights stay f32 (the [D] vector, all
+    server/compression state), the model body computes in bf16, and
+    the grad returns to f32 at the cast boundary. The bf16 rounding
+    noise lands inside the same error-feedback loop that already
+    absorbs compression error. Opt-in via --bf16 (a capability the
+    reference's fp32-only CUDA path doesn't have)."""
     def flat_grad(weights_vec, batch, mask):
         def scalar_loss(vec):
-            loss, metrics = loss_fn(unravel(vec), batch, mask)
-            return loss, metrics
+            params = unravel(vec)
+            b = batch
+            if compute_dtype is not None:
+                params = _cast_tree(params, compute_dtype)
+                b = _cast_tree(b, compute_dtype)
+            loss, metrics = loss_fn(params, b, mask)
+            return loss.astype(jnp.float32), _cast_tree(
+                metrics, jnp.float32)
         (loss, metrics), grad = jax.value_and_grad(
             scalar_loss, has_aux=True)(weights_vec)
         return loss, metrics, grad
     return flat_grad
 
 
-def make_flat_loss_fn(loss_fn: LossFn, unravel: Callable):
+def make_flat_loss_fn(loss_fn: LossFn, unravel: Callable,
+                      compute_dtype=None):
     """Loss-only counterpart of make_flat_grad_fn for the eval path:
     no value_and_grad, so eval jaxprs carry no backward ops at all —
     eval cost and compile time are forward-only by construction, not by
     hoping XLA DCEs an unused gradient (this matters at GPT2 size)."""
     def flat_loss(weights_vec, batch, mask):
-        return loss_fn(unravel(weights_vec), batch, mask)
+        params = unravel(weights_vec)
+        if compute_dtype is not None:
+            params = _cast_tree(params, compute_dtype)
+            batch = _cast_tree(batch, compute_dtype)
+        loss, metrics = loss_fn(params, batch, mask)
+        return loss.astype(jnp.float32), _cast_tree(metrics, jnp.float32)
     return flat_loss
 
 

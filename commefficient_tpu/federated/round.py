@@ -149,7 +149,9 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
     gradients at all (cv_train.py:377-384).
     """
     cfg.validate()
-    flat_grad = fclient.make_flat_grad_fn(loss_fn, unravel)
+    flat_grad = fclient.make_flat_grad_fn(
+        loss_fn, unravel,
+        compute_dtype=jnp.bfloat16 if cfg.do_bf16 else None)
     if grad_mask is not None:
         grad_mask = jnp.asarray(grad_mask, jnp.float32)
     # clients sharded over the `clients` axis only — further axes
@@ -338,7 +340,9 @@ def make_eval_fn(loss_fn: fclient.LossFn, unravel: Callable,
     Uses the loss-only flat fn: the eval jaxpr contains no backward
     ops (asserted by tests/test_client.py), so eval compiles and runs
     forward-only instead of relying on XLA to DCE an unused grad."""
-    flat_loss = fclient.make_flat_loss_fn(loss_fn, unravel)
+    flat_loss = fclient.make_flat_loss_fn(
+        loss_fn, unravel,
+        compute_dtype=jnp.bfloat16 if cfg.do_bf16 else None)
 
     def shard_eval(ps_weights, data, mask):
         def one_shard(b, m):

@@ -36,6 +36,7 @@ from commefficient_tpu.data import (
     FedValLoader, transforms,
 )
 from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.utils.checkpoint import (
     load_checkpoint, save_checkpoint, transfer_for_finetune,
 )
@@ -276,6 +277,7 @@ def _ckpt_path(cfg: Config) -> str:
 # ---------------- main (reference cv_train.py:289-421) -------------------
 
 def main(argv=None) -> bool:
+    enable_persistent_compilation_cache()
     cfg = parse_args(argv=argv)
     print(cfg)
     timer = Timer()

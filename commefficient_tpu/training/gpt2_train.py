@@ -40,6 +40,7 @@ from commefficient_tpu.models.gpt2 import (
 )
 from commefficient_tpu.parallel.mesh import make_client_model_mesh
 from commefficient_tpu.parallel.tp import tp_loss
+from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from commefficient_tpu.utils.logging import TableLogger, Timer, make_logdir
 from commefficient_tpu.utils.schedules import LambdaLR, PiecewiseLinear
@@ -289,6 +290,7 @@ def build_model_and_params(cfg: Config, tokenizer, seq_len: int,
 
 
 def main(argv=None) -> bool:
+    enable_persistent_compilation_cache()
     cfg = parse_args(default_lr=4e-2, argv=argv)
     if cfg.do_test:
         # smoke shrink of the compression geometry (cv_train applies

@@ -233,6 +233,25 @@ def test_eval_jaxpr_has_no_backward_ops():
     assert str(jax.make_jaxpr(train_path)(vec)).count("dot_general") > 2
 
 
+def test_bf16_compute_dtype_grad_close_to_f32():
+    """--bf16: model body computes in bfloat16, grads return f32 with
+    only bf16 rounding noise (absorbed by error feedback in training).
+    Closed form: same setup as test_forward_grad_closed_form."""
+    params = {"w": jnp.array([2.0])}
+    vec, unravel = flatten_params(params)
+    cfg = Config(mode="uncompressed", grad_size=1, weight_decay=0.0,
+                 num_workers=1, local_momentum=0.0, error_type="none",
+                 microbatch_size=-1)
+    fg = fc.make_flat_grad_fn(loss_fn, unravel,
+                              compute_dtype=jnp.bfloat16)
+    batch, mask = batch_of([1.0, 2.0], [0.0, 0.0])
+    g, loss, metrics, count = fc.forward_grad(fg, vec, batch, mask, cfg)
+    assert g.dtype == jnp.float32
+    assert loss.dtype == jnp.float32
+    np.testing.assert_allclose(g, [5.0], rtol=2e-2)
+    np.testing.assert_allclose(loss, 5.0, rtol=2e-2)
+
+
 def test_client_step_vmaps():
     """The round engine vmaps local_step over a shard's clients."""
     vec, cfg, fg = setup()

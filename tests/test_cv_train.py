@@ -32,6 +32,12 @@ def test_smoke_uncompressed_scan_rounds(tmp_path):
     assert run_main(tmp_path, "--mode", "uncompressed", "--scan_rounds")
 
 
+def test_smoke_bf16(tmp_path):
+    assert run_main(tmp_path, "--mode", "sketch",
+                    "--error_type", "virtual",
+                    "--virtual_momentum", "0.9", "--bf16")
+
+
 def test_checkpoint_and_resume(tmp_path):
     ck = str(tmp_path / "ck")
     assert run_main(tmp_path, "--mode", "uncompressed",
