@@ -61,6 +61,11 @@ PRESETS = {
 }
 
 
+# sequences at/above this length route through the flash-attention
+# path (ops/attention.py) instead of materializing [B, H, L, L]
+FLASH_ATTENTION_MIN_LEN = 256
+
+
 def _dense(features, cfg, name):
     return nn.Dense(
         features, name=name,
@@ -85,14 +90,22 @@ class SelfAttention(nn.Module):
             return x.reshape(B, L, H, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        # attention logits in f32 regardless of activation dtype
-        att = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                         preferred_element_type=jnp.float32)
-        att = att / jnp.sqrt(jnp.float32(hd))
-        causal = jnp.tril(jnp.ones((L, L), bool))
-        att = jnp.where(causal[None, None], att, jnp.float32(-1e9))
-        att = jax.nn.softmax(att, axis=-1).astype(v.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        if L >= FLASH_ATTENTION_MIN_LEN:
+            # long-context path: the Pallas flash kernel (XLA
+            # scan-tiled on non-TPU backends) — O(L * block) memory
+            # instead of the [B, H, L, L] score matrix
+            from commefficient_tpu.ops.attention import flash_attention
+            out = flash_attention(q, k, v).astype(v.dtype)
+        else:
+            # short sequences: plain einsum attention; logits in f32
+            # regardless of activation dtype
+            att = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                             preferred_element_type=jnp.float32)
+            att = att / jnp.sqrt(jnp.float32(hd))
+            causal = jnp.tril(jnp.ones((L, L), bool))
+            att = jnp.where(causal[None, None], att, jnp.float32(-1e9))
+            att = jax.nn.softmax(att, axis=-1).astype(v.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, L, E)
         return _dense(E, cfg, "c_proj")(out)
 

@@ -1,0 +1,315 @@
+"""Causal attention for long sequences: Pallas flash kernel + tiled VJP.
+
+The reference's GPT2 path materializes the full [B, H, L, L] score
+matrix inside pytorch_transformers (and our baseline einsum path does
+the same — models/gpt2.py SelfAttention), which is fine at PersonaChat
+lengths but quadratic-memory at long context. This module provides the
+long-context path, TPU-first:
+
+  * forward: a hand-written Pallas kernel (`_flash_fwd_kernel`) — grid
+    (batch*head, q-block, k-block) with the online-softmax state
+    (running max, denominator, accumulator) carried across k-block
+    grid steps in VMEM scratch, so per-program VMEM holds one q block
+    and one k/v block (O(block * Dh)), never a full [L, Dh] row or an
+    [L, L] score tile. Blocks strictly above the causal diagonal skip
+    their compute via `pl.when` (their DMAs still stream — the cost of
+    the dense-grid schedule, bounded at 2x bandwidth).
+  * backward: flash-style recomputation from the saved output and
+    per-row logsumexp, tiled as a `lax.scan` over k-blocks so the
+    backward also never materializes [L, L].
+  * `flash_attention` wraps both in a `jax.custom_vjp`, padding any
+    sequence length up to a block multiple internally (causality keeps
+    tail padding invisible to real queries; pad rows of the saved
+    logsumexp are poisoned to +big so the backward's recomputed
+    probabilities vanish there). On non-TPU backends (the CPU test
+    mesh) the forward runs the same online-softmax math as a scan
+    (`_flash_fwd_xla`); the Pallas kernel itself is covered by
+    interpret-mode tests (tests/test_attention.py).
+
+The online-softmax block fold is shared (`online_softmax_fold`)
+between the XLA forward and `parallel/ring.py`'s ring attention — one
+copy of the numerically delicate rescaling.
+
+Shapes: q, k, v [B, H, L, Dh], any L. Returns [B, H, L, Dh].
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 128
+NEG_INF = -1e30
+# pad rows of the saved logsumexp carry this so exp(s - lse) == 0
+LSE_PAD = 1e30
+
+
+def _resolve_scale(sm_scale: Optional[float], dh: int) -> float:
+    return sm_scale if sm_scale is not None else 1.0 / math.sqrt(dh)
+
+
+def _pad_len(L: int, block: int) -> int:
+    return -(-L // block) * block
+
+
+def _pad_seq(x, Lp):
+    pad = Lp - x.shape[2]
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
+# ---------------- shared online-softmax fold ----------------------------
+
+def online_softmax_fold(state, qs, kt, vt, q_pos, k_pos):
+    """One flash block fold: fold keys `kt`/values `vt` (global
+    positions `k_pos`) into the running (m, l, acc) softmax state of
+    queries `qs` (already scaled; global positions `q_pos`). Shapes:
+    qs [..., Lq, Dh], kt/vt [..., Lk, Dh], state m/l [..., Lq],
+    acc [..., Lq, Dh]. Causal: k > q masked."""
+    m, l, acc = state
+    s = jnp.einsum("...qd,...kd->...qk", qs, kt.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    rescale = jnp.exp(m - m_new)
+    l = l * rescale + p.sum(axis=-1)
+    acc = acc * rescale[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, vt.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l, acc
+
+
+# ---------------- Pallas forward kernel ---------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr, *,
+                      sm_scale: float, block_q: int, block_k: int):
+    """Grid (B*H, n_q, n_k), k innermost: scratch carries the online
+    state across k steps of one q block. Compute is skipped above the
+    causal diagonal."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # blocks strictly above the diagonal contribute nothing
+    @pl.when(kj * block_k <= qi * block_q + (block_q - 1))
+    def _fold():
+        q = q_ref[0].astype(jnp.float32) * sm_scale     # [bq, Dh]
+        k = k_ref[0].astype(jnp.float32)                # [bk, Dh]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [bq, bk]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m = m_scr[:, 0]                                  # [bq]
+        l = l_scr[:, 0]
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        rescale = jnp.exp(m - m_new)
+        l_new = l * rescale + p.sum(axis=1)
+        acc_scr[:] = acc_scr[:] * rescale[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_fwd_pallas(q, k, v, sm_scale, block_q, block_k,
+                      interpret=False):
+    B, H, L, Dh = q.shape
+    assert L % block_q == 0 and L % block_k == 0
+    qf = q.reshape(B * H, L, Dh)
+    kf = k.reshape(B * H, L, Dh)
+    vf = v.reshape(B * H, L, Dh)
+
+    kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                               block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, L // block_q, L // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, Dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, Dh), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, Dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, L, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B * H, L), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # denominator
+            pltpu.VMEM((block_q, Dh), jnp.float32),    # accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return o.reshape(B, H, L, Dh), lse.reshape(B, H, L)
+
+
+# ---------------- XLA forward (same math, scan-tiled) -------------------
+
+def _flash_fwd_xla(q, k, v, sm_scale, block_k) -> Tuple[jax.Array, jax.Array]:
+    """Online-softmax forward as a lax.scan over k blocks — identical
+    semantics to the kernel, runs on any backend, O(L * block) live."""
+    B, H, L, Dh = q.shape
+    qs = q.astype(jnp.float32) * sm_scale
+    n_blocks = L // block_k
+    kb = k.reshape(B, H, n_blocks, block_k, Dh)
+    vb = v.reshape(B, H, n_blocks, block_k, Dh)
+    q_pos = jnp.arange(L)
+
+    def body(carry, xs):
+        kj, vj, j = xs
+        k_pos = j * block_k + jnp.arange(block_k)
+        return online_softmax_fold(carry, qs, kj, vj, q_pos, k_pos), None
+
+    m0 = jnp.full((B, H, L), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, L), jnp.float32)
+    acc0 = jnp.zeros((B, H, L, Dh), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0),
+        (kb.transpose(2, 0, 1, 3, 4), vb.transpose(2, 0, 1, 3, 4),
+         jnp.arange(n_blocks)))
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (acc / l_safe[..., None]).astype(q.dtype)
+    return o, m + jnp.log(l_safe)
+
+
+# ---------------- tiled backward (shared) -------------------------------
+
+def _flash_bwd_xla(q, k, v, o, lse, do, sm_scale, block_k):
+    """Flash-style backward from saved (o, lse): recompute p per
+    k-block, never materializing [L, L]. Zero-padded `do` and
+    LSE_PAD-poisoned `lse` rows make sequence padding contribute
+    exactly zero to every gradient."""
+    B, H, L, Dh = q.shape
+    qs = q.astype(jnp.float32)
+    do_f = do.astype(jnp.float32)
+    o_f = o.astype(jnp.float32)
+    delta = (do_f * o_f).sum(axis=-1)                   # [B, H, L]
+    n_blocks = L // block_k
+    kb = k.reshape(B, H, n_blocks, block_k, Dh).astype(jnp.float32)
+    vb = v.reshape(B, H, n_blocks, block_k, Dh).astype(jnp.float32)
+    q_pos = jnp.arange(L)
+
+    def body(dq, xs):
+        kj, vj, j = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs * sm_scale, kj,
+                       preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jnp.arange(block_k)
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                 # [B,H,L,bk]
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, do_f,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do_f, vj,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None])                # [B,H,L,bk]
+        dq = dq + sm_scale * jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, kj,
+            preferred_element_type=jnp.float32)
+        dk_j = sm_scale * jnp.einsum(
+            "bhqk,bhqd->bhkd", ds, qs,
+            preferred_element_type=jnp.float32)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((B, H, L, Dh), jnp.float32)
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        body, dq0,
+        (kb.transpose(2, 0, 1, 3, 4), vb.transpose(2, 0, 1, 3, 4),
+         jnp.arange(n_blocks)))
+    dk = dk_b.transpose(1, 2, 0, 3, 4).reshape(B, H, L, Dh)
+    dv = dv_b.transpose(1, 2, 0, 3, 4).reshape(B, H, L, Dh)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------- public op ---------------------------------------------
+
+def _on_tpu() -> bool:
+    return jax.devices()[0].platform == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, sm_scale: Optional[float] = None):
+    """Causal flash attention, [B, H, L, Dh] -> [B, H, L, Dh]."""
+    o, _ = _fa_fwd_impl(q, k, v, sm_scale)
+    return o
+
+
+def _fa_fwd_impl(q, k, v, sm_scale):
+    L = q.shape[2]
+    scale = _resolve_scale(sm_scale, q.shape[-1])
+    block = min(DEFAULT_BLOCK, L)
+    Lp = _pad_len(L, block)
+    qp, kp, vp = (_pad_seq(x, Lp) for x in (q, k, v))
+    # tail padding is invisible to real queries under the causal mask
+    # (pad positions are strictly later), so outputs [:L] are exact
+    if _on_tpu():
+        o, lse = _flash_fwd_pallas(qp, kp, vp, scale, block, block)
+    else:
+        o, lse = _flash_fwd_xla(qp, kp, vp, scale, block)
+    return o[:, :, :L], lse[:, :, :L]
+
+
+def _fa_fwd(q, k, v, sm_scale):
+    o, lse = _fa_fwd_impl(q, k, v, sm_scale)
+    return o, (q, k, v, o, lse)
+
+
+def _fa_bwd(sm_scale, res, do):
+    q, k, v, o, lse = res
+    L = q.shape[2]
+    scale = _resolve_scale(sm_scale, q.shape[-1])
+    block = min(DEFAULT_BLOCK, L)
+    Lp = _pad_len(L, block)
+    qp, kp, vp, op, dop = (_pad_seq(x, Lp) for x in (q, k, v, o, do))
+    pad = Lp - L
+    lsep = (jnp.pad(lse, ((0, 0), (0, 0), (0, pad)),
+                    constant_values=LSE_PAD) if pad else lse)
+    dq, dk, dv = _flash_bwd_xla(qp, kp, vp, op, lsep, dop, scale, block)
+    return dq[:, :, :L], dk[:, :, :L], dv[:, :, :L]
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def reference_attention(q, k, v, sm_scale: Optional[float] = None):
+    """O(L^2)-memory einsum attention (the models/gpt2.py baseline
+    path), for equivalence tests."""
+    scale = _resolve_scale(sm_scale, q.shape[-1])
+    L = q.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    causal = jnp.tril(jnp.ones((L, L), bool))
+    s = jnp.where(causal[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

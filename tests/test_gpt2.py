@@ -151,3 +151,29 @@ def test_sketched_round_tiny_gpt2(mesh):
     assert np.isfinite(np.asarray(new_server.ps_weights)).all()
     # weights moved
     assert float(jnp.abs(new_server.ps_weights - vec).sum()) > 0
+
+
+def test_flash_attention_path_matches_einsum(monkeypatch):
+    """At L >= FLASH_ATTENTION_MIN_LEN the transformer routes through
+    the flash kernel path (ops/attention.py); logits must match the
+    einsum path it replaces."""
+    from commefficient_tpu.models import gpt2 as G
+
+    gcfg = G.GPT2Config(vocab_size=64, n_positions=256, n_embd=32,
+                        n_layer=2, n_head=2)
+    module = G.GPT2DoubleHeads(gcfg)
+    rng = np.random.RandomState(0)
+    L = 256
+    ids = jnp.asarray(rng.randint(0, 64, (1, 2, L)), jnp.int32)
+    tt = jnp.asarray(rng.randint(0, 64, (1, 2, L)), jnp.int32)
+    mc = jnp.asarray(rng.randint(0, L, (1, 2)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), ids, tt, mc)
+
+    assert L >= G.FLASH_ATTENTION_MIN_LEN
+    lm_flash, mc_flash = module.apply(params, ids, tt, mc)
+    monkeypatch.setattr(G, "FLASH_ATTENTION_MIN_LEN", 1 << 30)
+    lm_ein, mc_ein = module.apply(params, ids, tt, mc)
+    np.testing.assert_allclose(np.asarray(lm_flash), np.asarray(lm_ein),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(mc_flash), np.asarray(mc_ein),
+                               rtol=2e-4, atol=2e-4)
