@@ -159,15 +159,24 @@ class FedModel:
                               jnp.asarray(mask)),
             self._lr(), self._key)
 
-        # communication accounting (host side, overlapped with device)
+        # Communication accounting with ONE round of lag: this round's
+        # change bitset is dispatched and its device->host copy started
+        # asynchronously; the popcount consumes the PREVIOUS round's
+        # bits, which are already on the host. Materializing the fresh
+        # bits here instead would block on the round that was just
+        # dispatched — a full round-trip of sync per round on the
+        # tunnel (PERF.md measurement rules).
+        bits = self._pack_bits(self.server.ps_weights - prev_weights)
+        bits.copy_to_host_async()
         download, upload = self.accountant.record_round(
-            np.asarray(client_ids), self._prev_change_words)
-        self._prev_change_words = np.asarray(
-            self._pack_bits(self.server.ps_weights - prev_weights))
+            np.asarray(client_ids),
+            None if self._prev_change_words is None
+            else np.asarray(self._prev_change_words))
+        self._prev_change_words = bits
 
-        losses = np.asarray(metrics.losses)
-        mets = [np.asarray(m) for m in metrics.metrics]
-        return [losses, *mets, download, upload]
+        # metrics stay device arrays: callers that float() them decide
+        # when to pay the sync (drivers materialize with a 1-round lag)
+        return [metrics.losses, *metrics.metrics, download, upload]
 
     def run_rounds(self, client_ids, data, mask, lrs, account: bool = True):
         """Run N federated rounds as ONE device program (scanned; see
@@ -197,6 +206,10 @@ class FedModel:
         upload = np.zeros(self.num_clients)
         bits_host = np.asarray(bits)
         ids_host = np.asarray(client_ids)
+        if self._prev_change_words is not None:
+            # may still be a device array from a preceding single-round
+            # call (the lazy-sync path in _call_train)
+            self._prev_change_words = np.asarray(self._prev_change_words)
         for n in range(ids_host.shape[0]):
             if account:
                 d, u = self.accountant.record_round(

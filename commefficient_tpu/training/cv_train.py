@@ -196,6 +196,17 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 up += u
             rounds_done += taken
         else:
+            # metrics materialize with a ONE-ROUND lag: float()ing the
+            # round just dispatched would block the host on the device
+            # every round (a full tunnel round-trip — PERF.md); round
+            # t-1's values are already computed, so float() is free.
+            # NaN abort latency grows by exactly one round.
+            def emit(p) -> bool:
+                losses.append(float(np.mean(p[0])))
+                accs.append(float(np.mean(p[1])))
+                return not np.isnan(losses[-1])
+
+            pending = None
             for client_ids, data, mask in train_loader.epoch():
                 if rounds_done >= total_rounds:
                     break
@@ -204,11 +215,13 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 opt.step()
                 down += d
                 up += u
-                losses.append(float(loss.mean()))
-                accs.append(float(acc.mean()))
-                rounds_done += 1
-                if np.isnan(losses[-1]):
+                if pending is not None and not emit(pending):
+                    pending = None
                     break
+                pending = (loss, acc)
+                rounds_done += 1
+            if pending is not None:
+                emit(pending)
 
         total_down += down
         total_up += up

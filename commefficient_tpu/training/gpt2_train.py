@@ -156,6 +156,28 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
         frac = (cfg.num_epochs - epoch
                 if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
         losses = []
+
+        # per-batch metrics are logged with a ONE-ROUND lag: round t-1
+        # is already computed when round t dispatches, so float() costs
+        # nothing; float()ing the fresh round would block the host
+        # every round (PERF.md). NaN abort latency grows by one round.
+        def emit(p) -> bool:
+            bidx, lr_v, l_, lm_, mc_ = p
+            losses.append(float(np.mean(l_)))
+            logger.append({
+                "batch_idx": bidx,
+                "lr": round(lr_v, 5),
+                "train_time": timer(),
+                "train_loss": losses[-1],
+                "lm_loss": float(np.mean(lm_)),
+                "mc_loss": float(np.mean(mc_)),
+                "total_time": timer.total_time,
+            })
+            return not (np.isnan(losses[-1])
+                        or losses[-1] > cfg.nan_threshold)
+
+        pending = None
+        aborted = False
         for client_ids, data, mask in train_loader.epoch():
             if batch_idx - epoch * spe >= spe * frac:
                 break
@@ -163,26 +185,24 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
             loss, lm, mc, down, up = model((client_ids, data, mask))
             opt.step()
             batch_idx += 1
-            losses.append(float(np.mean(loss)))
             if epoch == 0:
                 # download deltas are only trusted for epoch 1
                 # (reference gpt2_train.py:132-137)
                 epoch_download += down.sum() / (1024 ** 2)
                 epoch_upload += up.sum() / (1024 ** 2)
-            logger.append({
-                "batch_idx": batch_idx,
-                "lr": round(float(opt.param_groups[0]["lr"]), 5),
-                "train_time": timer(),
-                "train_loss": losses[-1],
-                "lm_loss": float(np.mean(lm)),
-                "mc_loss": float(np.mean(mc)),
-                "total_time": timer.total_time,
-            })
-            if np.isnan(losses[-1]) or losses[-1] > cfg.nan_threshold:
-                print(f"found nan/divergent loss {losses[-1]}, aborting")
-                if cfg.do_profile and epoch == 0:
-                    jax.profiler.stop_trace()
-                return False
+            if pending is not None and not emit(pending):
+                pending = None
+                aborted = True
+                break
+            pending = (batch_idx, float(opt.param_groups[0]["lr"]),
+                       loss, lm, mc)
+        if pending is not None and not emit(pending):
+            aborted = True
+        if aborted:
+            print(f"found nan/divergent loss {losses[-1]}, aborting")
+            if cfg.do_profile and epoch == 0:
+                jax.profiler.stop_trace()
+            return False
         if cfg.do_profile and epoch == 0:
             jax.profiler.stop_trace()
             print(f"profile trace written to "
