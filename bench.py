@@ -176,6 +176,10 @@ def main() -> int:
         local_momentum=0.0, weight_decay=5e-4, microbatch_size=-1,
         num_workers=NUM_WORKERS, num_clients=10 * NUM_WORKERS,
         grad_size=D,
+        # BENCH_BF16=1 measures the --bf16 round (bf16 client fwd/bwd,
+        # f32 master weights); the baseline stand-in stays f32 either
+        # way, since the reference's CUDA path is fp32-only
+        do_bf16=os.environ.get("BENCH_BF16", "") == "1",
     ).validate()
 
     def loss_fn(params, batch, mask):
@@ -302,6 +306,8 @@ def main() -> int:
         "local_batch": LOCAL_BATCH,
         "grad_size": D,
     }
+    if cfg.do_bf16:
+        out["bf16"] = True
     if flops_per_round:
         tflops_per_s = flops_per_round / (round_ms / 1e3) / 1e12
         out["flops_per_round"] = flops_per_round

@@ -37,6 +37,7 @@ from commefficient_tpu.data import (
 )
 from commefficient_tpu.federated.api import FedModel, FedOptimizer
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
+from commefficient_tpu.training.scanloop import run_scanned_rounds
 from commefficient_tpu.utils.checkpoint import (
     load_checkpoint, save_checkpoint, transfer_for_finetune,
 )
@@ -161,39 +162,35 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
         if cfg.scan_rounds:
             # scanned device programs, flushed every --scan_span rounds
-            # to bound the staged [N, W, B, ...] arrays (0 = whole epoch)
-            span_cap = cfg.scan_span if cfg.scan_span > 0 else epoch_rounds
+            # to bound the staged [N, W, B, ...] arrays (0 = whole
+            # epoch); staging/flush mechanics shared with gpt2_train
+            # (training/scanloop.py)
             taken = 0
-            ids, datas, masks, lrs = [], [], [], []
 
-            def flush():
-                loss_nw, acc_nw, d, u = model.run_rounds(
-                    np.stack(ids),
-                    tuple(np.stack([dd[i] for dd in datas])
-                          for i in range(len(datas[0]))),
-                    np.stack(masks), np.asarray(lrs))
-                losses.extend(loss_nw.mean(axis=1))
-                accs.extend(acc_nw.mean(axis=1))
-                return d, u
+            def stream():
+                nonlocal taken
+                for client_ids, data, mask in train_loader.epoch():
+                    if taken == epoch_rounds:
+                        return
+                    lr_scheduler.step()
+                    taken += 1
+                    yield (None, client_ids, data, mask,
+                           opt.param_groups[0]["lr"])
 
-            for client_ids, data, mask in train_loader.epoch():
-                if taken == epoch_rounds:
-                    break
-                lr_scheduler.step()
-                lrs.append(opt.param_groups[0]["lr"])
-                ids.append(client_ids)
-                datas.append(data)
-                masks.append(mask)
-                taken += 1
-                if len(ids) == span_cap:
-                    d, u = flush()
-                    down += d
-                    up += u
-                    ids, datas, masks, lrs = [], [], [], []
-            if ids:
-                d, u = flush()
+            def scan_emit(_tag, loss_w, acc_w):
+                losses.append(float(np.mean(loss_w)))
+                accs.append(float(np.mean(acc_w)))
+                return True  # NaN abort handled by the epoch-mean check
+
+            def on_comm(d, u):
+                nonlocal down, up
                 down += d
                 up += u
+
+            run_scanned_rounds(
+                model, stream(),
+                cfg.scan_span if cfg.scan_span > 0 else epoch_rounds,
+                scan_emit, on_comm)
             rounds_done += taken
         else:
             # metrics materialize with a ONE-ROUND lag: float()ing the

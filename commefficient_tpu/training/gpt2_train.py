@@ -40,6 +40,7 @@ from commefficient_tpu.models.gpt2 import (
 )
 from commefficient_tpu.parallel.mesh import make_client_model_mesh
 from commefficient_tpu.parallel.tp import tp_loss
+from commefficient_tpu.training.scanloop import run_scanned_rounds
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from commefficient_tpu.utils.logging import TableLogger, Timer, make_logdir
@@ -178,26 +179,53 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
         pending = None
         aborted = False
-        for client_ids, data, mask in train_loader.epoch():
-            if batch_idx - epoch * spe >= spe * frac:
-                break
-            lr_scheduler.step()
-            loss, lm, mc, down, up = model((client_ids, data, mask))
-            opt.step()
-            batch_idx += 1
-            if epoch == 0:
-                # download deltas are only trusted for epoch 1
-                # (reference gpt2_train.py:132-137)
-                epoch_download += down.sum() / (1024 ** 2)
-                epoch_upload += up.sum() / (1024 ** 2)
+        if cfg.scan_rounds:
+            # scanned device programs, flushed every --scan_span rounds
+            # (symmetric with cv_train; bounds the staged token arrays)
+            def stream():
+                nonlocal batch_idx
+                for client_ids, data, mask in train_loader.epoch():
+                    if batch_idx - epoch * spe >= spe * frac:
+                        return
+                    lr_scheduler.step()
+                    batch_idx += 1
+                    lr_v = opt.param_groups[0]["lr"]
+                    yield ((batch_idx, float(lr_v)), client_ids, data,
+                           mask, lr_v)
+
+            def on_comm(d, u):
+                nonlocal epoch_download, epoch_upload
+                if epoch == 0:
+                    epoch_download += d.sum() / (1024 ** 2)
+                    epoch_upload += u.sum() / (1024 ** 2)
+
+            aborted = not run_scanned_rounds(
+                model, stream(),
+                cfg.scan_span if cfg.scan_span > 0 else spe,
+                lambda tag, l_, lm_, mc_: emit(
+                    (tag[0], tag[1], l_, lm_, mc_)),
+                on_comm)
+        else:
+            for client_ids, data, mask in train_loader.epoch():
+                if batch_idx - epoch * spe >= spe * frac:
+                    break
+                lr_scheduler.step()
+                loss, lm, mc, down, up = model((client_ids, data, mask))
+                opt.step()
+                batch_idx += 1
+                if epoch == 0:
+                    # download deltas are only trusted for epoch 1
+                    # (reference gpt2_train.py:132-137)
+                    epoch_download += down.sum() / (1024 ** 2)
+                    epoch_upload += up.sum() / (1024 ** 2)
+                if pending is not None and not emit(pending):
+                    pending = None
+                    aborted = True
+                    break
+                pending = (batch_idx, float(opt.param_groups[0]["lr"]),
+                           loss, lm, mc)
             if pending is not None and not emit(pending):
-                pending = None
                 aborted = True
-                break
-            pending = (batch_idx, float(opt.param_groups[0]["lr"]),
-                       loss, lm, mc)
-        if pending is not None and not emit(pending):
-            aborted = True
         if aborted:
             print(f"found nan/divergent loss {losses[-1]}, aborting")
             if cfg.do_profile and epoch == 0:

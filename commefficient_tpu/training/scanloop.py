@@ -1,0 +1,62 @@
+"""Shared staging loop for --scan_rounds: collect rounds into spans,
+run each span as ONE scanned device program (FedModel.run_rounds), and
+emit per-round metrics.
+
+Both drivers run the same mechanics (span_cap derivation, host-side
+[N, W, B, ...] staging, the np.stack flush, the partial tail span) and
+previously each carried its own copy; only what they DO with a round's
+metric rows differs, so that part is the `emit` callback.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def run_scanned_rounds(model, stream: Iterable[Tuple],
+                       span_cap: int,
+                       emit: Callable[..., bool],
+                       on_comm: Optional[Callable[[np.ndarray, np.ndarray],
+                                                  None]] = None) -> bool:
+    """Drive scanned spans over `stream`, which yields
+    (tag, client_ids, data_tuple, mask, lr) per round — the caller owns
+    round-budget/epoch-boundary logic by just ending the stream.
+
+    Per flushed span: on_comm(download, upload) once (host accounting
+    totals), then emit(tag, *per_round_metric_rows) once per round IN
+    ORDER. emit returning False aborts immediately (the remaining
+    rounds of the span are neither emitted nor logged — matching the
+    unscanned loop, which stops at the first bad round).
+
+    Returns True if every emit succeeded, False on abort.
+    """
+    ids, datas, masks, lrs, tags = [], [], [], [], []
+
+    def flush() -> bool:
+        out = model.run_rounds(
+            np.stack(ids),
+            tuple(np.stack([dd[i] for dd in datas])
+                  for i in range(len(datas[0]))),
+            np.stack(masks), np.asarray(lrs))
+        *metric_rows, down, up = out
+        if on_comm is not None:
+            on_comm(down, up)
+        for n in range(len(ids)):
+            if not emit(tags[n], *[m[n] for m in metric_rows]):
+                return False
+        return True
+
+    for tag, client_ids, data, mask, lr in stream:
+        ids.append(client_ids)
+        datas.append(data)
+        masks.append(mask)
+        lrs.append(lr)
+        tags.append(tag)
+        if len(ids) == span_cap:
+            if not flush():
+                return False
+            ids, datas, masks, lrs, tags = [], [], [], [], []
+    if ids:
+        return flush()
+    return True

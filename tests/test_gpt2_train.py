@@ -82,6 +82,15 @@ def test_finetune_roundtrip(tmp_path):
     np.testing.assert_allclose(got, want)
 
 
+def test_smoke_scan_rounds(tmp_path):
+    """--scan_rounds runs the epoch as scanned device programs
+    (parity with cv_train's scanned path)."""
+    assert run_main(tmp_path, "--mode", "sketch",
+                    "--error_type", "virtual",
+                    "--virtual_momentum", "0.9", "--scan_rounds",
+                    "--scan_span", "2")
+
+
 def test_smoke_tensor_parallel(tmp_path):
     """--model_parallel 2 runs the same driver on a (clients, model)
     mesh (4x2 on the 8-device CPU test mesh)."""
