@@ -192,7 +192,24 @@ def main() -> int:
         acc = ((logits.argmax(-1) == yb) * mask).sum() / denom
         return loss, (acc,)
 
-    train_round = fround.make_train_fn(loss_fn, unravel, cfg, mesh)
+    def build_digest(cfg_variant):
+        """Jitted scanned-round digest for a config variant: every
+        output feeds ONE scalar (nothing DCE-able, one 4-byte sync)."""
+        tr = fround.make_train_fn(loss_fn, unravel, cfg_variant, mesh)
+        run_variant = tr.train_rounds
+
+        @jax.jit
+        def digest(server, clients, batches, lrs, key):
+            server2, clients2, m, bits = run_variant(
+                server, clients, batches, lrs, key)
+            leaves = [l for l in jax.tree.leaves(clients2) if l.size > 0]
+            client_digest = sum([l.reshape(-1)[0] for l in leaves],
+                                jnp.float32(0))
+            return (m.losses.mean() + server2.ps_weights[0]
+                    + bits.sum(dtype=jnp.uint32).astype(jnp.float32)
+                    + client_digest)
+        return digest
+
     server = fround.init_server_state(cfg, vec)
     clients = fround.init_client_state(cfg, cfg.resolved_num_clients(),
                                        vec, mesh=mesh)
@@ -218,25 +235,11 @@ def main() -> int:
         jnp.broadcast_to(batch.mask, (ROUNDS,) + batch.mask.shape))
     lrs = jnp.full((ROUNDS,), 0.1)
 
-    run = train_round.train_rounds
-
-    # One jitted digest wrapping the scanned program: every output
-    # (incl. the change bitsets and final weights) feeds one scalar, so
-    # nothing is DCE-able and the measurement pays exactly ONE dispatch
-    # + a 4-byte transfer. Syncing the raw outputs instead costs ~70 ms
-    # of axon-tunnel latency PER access (ps_weights[0] is its own
-    # dispatch) — ~20 ms/round of pure measurement artifact at
-    # ROUNDS=10 (see PERF.md).
-    @jax.jit
-    def run_digest(server, clients, batches, lrs, key):
-        server2, clients2, m, bits = run(server, clients, batches, lrs,
-                                         key)
-        leaves = [l for l in jax.tree.leaves(clients2) if l.size > 0]
-        client_digest = sum([l.reshape(-1)[0] for l in leaves],
-                            jnp.float32(0))
-        return (m.losses.mean() + server2.ps_weights[0]
-                + bits.sum(dtype=jnp.uint32).astype(jnp.float32)
-                + client_digest)
+    # One jitted digest wrapping the scanned program (see build_digest:
+    # syncing raw outputs instead costs ~70 ms of axon-tunnel latency
+    # PER access — ~20 ms/round of measurement artifact at ROUNDS=10;
+    # see PERF.md).
+    run_digest = build_digest(cfg)
 
     t0 = time.time()
     with alarm_guard(STAGE_TIMEOUT, "compile+first run"):
@@ -295,6 +298,29 @@ def main() -> int:
         ref_round_ms = (float(np.median(reps)) / ROUNDS * 1e3
                         * NUM_WORKERS)
 
+    # secondary measurement: the --bf16 round (TPU-native fast path;
+    # f32 master weights). Reported as extra fields — the primary
+    # `value`/`vs_baseline` stay the f32 round vs the f32 baseline, the
+    # apples-to-apples comparison with the reference's fp32 CUDA path.
+    bf16_round_ms = None
+    if not cfg.do_bf16 and platform == "tpu":
+        try:
+            digest_bf16 = build_digest(cfg.replace(do_bf16=True))
+            with alarm_guard(STAGE_TIMEOUT, "bf16 compile+measure"):
+                float(np.asarray(digest_bf16(server, clients, batches,
+                                             lrs, key)))
+                reps = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    float(np.asarray(digest_bf16(server, clients,
+                                                 batches, lrs, key)))
+                    reps.append(time.perf_counter() - t0)
+            bf16_round_ms = float(np.median(reps)) / ROUNDS * 1e3
+        except StageTimeout:
+            log("bf16 measurement timed out; omitting")
+        except Exception as e:
+            log(f"bf16 measurement failed: {e}")
+
     out = {
         "metric": "cifar10_resnet9_sketch_round_time",
         "value": round(round_ms, 3),
@@ -308,6 +334,9 @@ def main() -> int:
     }
     if cfg.do_bf16:
         out["bf16"] = True
+    if bf16_round_ms is not None:
+        out["value_bf16"] = round(bf16_round_ms, 3)
+        out["vs_baseline_bf16"] = round(ref_round_ms / bf16_round_ms, 3)
     if flops_per_round:
         tflops_per_s = flops_per_round / (round_ms / 1e3) / 1e12
         out["flops_per_round"] = flops_per_round
