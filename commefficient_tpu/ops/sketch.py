@@ -78,12 +78,13 @@ import numpy as np
 STATIC_UNROLL_LIMIT = 2048
 
 # decode_topk_sparse may materialize the full [n_chunks, c] estimate
-# (fast single approx_max_k select) only below this element count
-# (64 MiB of f32; the flagship 14 x 500k geometry is 7M elements).
-# Above it, the blockwise scan keeps live memory at O(c) — the
-# SURVEY.md §7.3 invariant for d = O(1e8), where r * n_chunks can
-# still sit under STATIC_UNROLL_LIMIT while d floats would not fit.
-DECODE_MATERIALIZE_LIMIT = 16 * 1024 * 1024
+# (fast single approx_max_k select) only below this element count.
+# The estimate is ~padded-d floats, so 256M elements = 1 GiB f32 (x2
+# transient for the squared copy): GPT2-small's D=124M decodes on the
+# fast path, while d = O(1e9) — where several d-sized f32 temporaries
+# would crowd a 16 GiB HBM — falls back to the blockwise scan that
+# keeps live memory at O(c) (SURVEY.md §7.3 hard part #1).
+DECODE_MATERIALIZE_LIMIT = 256 * 1024 * 1024
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
