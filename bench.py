@@ -45,7 +45,11 @@ ROUNDS = int(os.environ.get("BENCH_ROUNDS", "10"))
 # BENCH_SMALL=1 shrinks model + sketch geometry (CPU smoke of the
 # bench mechanism; the reported numbers are always full-size TPU runs)
 SMALL = os.environ.get("BENCH_SMALL", "") == "1"
-INIT_TIMEOUT = int(os.environ.get("BENCH_INIT_TIMEOUT", "120"))
+# the axon tunnel sometimes needs minutes to wake after idling (it
+# hung jax.devices() for hours during round 3); give the TPU child a
+# generous retry window before it degrades to CPU — the parent's hard
+# kill (BENCH_TPU_TIMEOUT) still bounds the worst case
+INIT_TIMEOUT = int(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
 STAGE_TIMEOUT = int(os.environ.get("BENCH_STAGE_TIMEOUT", "900"))
 
 # bf16 peak TFLOP/s per chip, for the MFU estimate
