@@ -113,6 +113,9 @@ class Config:
     # f32 server/compression state; see client.make_flat_grad_fn) —
     # the MXU's fast path, an extension over the reference's fp32 CUDA
     do_bf16: bool = False
+    # rematerialize transformer blocks on backward (GPT2 workload):
+    # O(1)-block activation memory for ~1/3 extra FLOPs
+    do_remat: bool = False
     # cap on the static per-client batch dim when local_batch_size=-1
     # (whole-client batches). Uncapped, fedavg at ImageNet scale stages
     # max(data_per_client) examples per client slot (~2.4 GB f32 at
@@ -305,6 +308,9 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                         "axis (GPT2-scale models; parallel/tp.py)")
     p.add_argument("--bf16", action="store_true", dest="do_bf16",
                    help="bfloat16 client fwd/bwd (f32 master weights)")
+    p.add_argument("--remat", action="store_true", dest="do_remat",
+                   help="rematerialize GPT2 blocks on backward "
+                        "(activation memory -> O(1) blocks)")
     p.add_argument("--iid", action="store_true", dest="do_iid")
     p.add_argument("--train_dataloader_workers", type=int, default=0)
     p.add_argument("--val_dataloader_workers", type=int, default=0)

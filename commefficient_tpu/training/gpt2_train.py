@@ -318,6 +318,10 @@ def build_model_and_params(cfg: Config, tokenizer, seq_len: int,
             # tokenizer (no resize step needed)
             gcfg = gcfg.replace(vocab_size=vocab)
 
+    # remat is an execution-layout choice, not part of the artifact:
+    # apply the flag regardless of where the config came from
+    gcfg = gcfg.replace(remat=cfg.do_remat)
+
     if pretrained is not None:
         params = pretrained
         if vocab > gcfg.vocab_size:

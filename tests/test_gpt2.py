@@ -177,3 +177,35 @@ def test_flash_attention_path_matches_einsum(monkeypatch):
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(mc_flash), np.asarray(mc_ein),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_remat_preserves_values_and_grads():
+    """remat=True must change memory scheduling only — identical
+    logits and gradients."""
+    from commefficient_tpu.models import gpt2 as G
+    from commefficient_tpu.ops.flat import flatten_params
+
+    rng = np.random.RandomState(0)
+    L = 16
+    ids = jnp.asarray(rng.randint(0, 64, (1, 2, L)), jnp.int32)
+    mc = jnp.asarray(rng.randint(0, L, (1, 2)), jnp.int32)
+
+    outs = []
+    for remat in (False, True):
+        gcfg = G.GPT2Config(vocab_size=64, n_positions=L, n_embd=32,
+                            n_layer=2, n_head=2, remat=remat)
+        module = G.GPT2DoubleHeads(gcfg)
+        params = module.init(jax.random.PRNGKey(0), ids, ids, mc)
+        vec, unravel = flatten_params(params)
+
+        def loss(v):
+            lm, mcl = module.apply(unravel(v), ids, ids, mc)
+            return (lm ** 2).mean() + (mcl ** 2).mean()
+
+        outs.append((loss(vec), jax.grad(loss)(vec)))
+
+    np.testing.assert_allclose(np.asarray(outs[0][0]),
+                               np.asarray(outs[1][0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(outs[0][1]),
+                               np.asarray(outs[1][1]),
+                               rtol=1e-5, atol=1e-7)
