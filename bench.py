@@ -353,18 +353,6 @@ def main() -> int:
     return 0
 
 
-def _worker_main() -> int:
-    global _DEADLINE
-    budget = os.environ.get("BENCH_CHILD_BUDGET")
-    if budget:
-        _DEADLINE = time.time() + int(budget)
-    try:
-        return main()
-    except StageTimeout as e:
-        log(f"FATAL: stage timed out: {e}")
-        return 3
-
-
 def _run_child(extra_env, timeout_s, script=None):
     """Run the measurement in a child process; returns the parsed JSON
     line or None. A hard kill-on-timeout is the only watchdog that
@@ -378,8 +366,15 @@ def _run_child(extra_env, timeout_s, script=None):
         r = subprocess.run(
             [sys.executable, script or os.path.abspath(__file__)],
             capture_output=True, text=True, timeout=timeout_s, env=env)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         log(f"child timed out after {timeout_s}s ({extra_env})")
+        # relay whatever the child managed to say (e.g. completed
+        # profile stages on stderr) before the hard kill
+        err = e.stderr or b""
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        for line in err.splitlines()[-20:]:
+            log(f"  child(killed): {line}")
         return None
     for line in r.stderr.splitlines()[-20:]:
         log(f"  child: {line}")
@@ -394,22 +389,49 @@ def _run_child(extra_env, timeout_s, script=None):
     return None
 
 
-def orchestrate() -> int:
-    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
-    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
-
+def run_orchestrated(small_env_key, script=None,
+                     tpu_timeout=None, cpu_timeout=None):
+    """The shared TPU-child-then-small-CPU-child sequence used by this
+    bench, benchmarks/bench_gpt2.py, and benchmarks/profile_round.py:
+    try a TPU child (unless JAX_PLATFORMS=cpu), fall back to a CPU
+    child with `small_env_key`=1 on a forced 8-device host mesh.
+    Returns the parsed JSON dict, or None if every child died."""
+    if tpu_timeout is None:
+        tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
+    if cpu_timeout is None:
+        cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
     out = None
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        out = _run_child({}, tpu_timeout)
+        out = _run_child({}, tpu_timeout, script=script)
         if out is not None and out.get("platform") == "cpu":
             log("TPU child self-degraded to CPU")
     if out is None:
-        log("falling back to a CPU child (BENCH_SMALL geometry)")
-        out = _run_child({"JAX_PLATFORMS": "cpu", "BENCH_SMALL": "1",
+        log(f"falling back to a CPU child ({small_env_key} geometry)")
+        out = _run_child({"JAX_PLATFORMS": "cpu", small_env_key: "1",
                           "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
                                         + " --xla_force_host_platform"
                                           "_device_count=8").strip()},
-                         cpu_timeout)
+                         cpu_timeout, script=script)
+    return out
+
+
+def worker_entry(main_fn) -> int:
+    """Shared child-side entry: arm the child-wide alarm_guard budget
+    from BENCH_CHILD_BUDGET (so stages fail fast before the parent's
+    hard kill), then run main_fn."""
+    global _DEADLINE
+    budget = os.environ.get("BENCH_CHILD_BUDGET")
+    if budget:
+        _DEADLINE = time.time() + int(budget)
+    try:
+        return main_fn() or 0
+    except StageTimeout as e:
+        log(f"FATAL: stage timed out: {e}")
+        return 3
+
+
+def orchestrate() -> int:
+    out = run_orchestrated("BENCH_SMALL")
     if out is None:
         out = {"metric": "cifar10_resnet9_sketch_round_time",
                "value": None, "unit": "ms/round", "vs_baseline": None,
@@ -420,5 +442,5 @@ def orchestrate() -> int:
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_IS_WORKER") == "1":
-        raise SystemExit(_worker_main())
+        raise SystemExit(worker_entry(main))
     raise SystemExit(orchestrate())

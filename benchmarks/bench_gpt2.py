@@ -215,23 +215,8 @@ def main() -> int:
 def orchestrate() -> int:
     """Parent: run main() in a hard-killed child, degrading to a CPU
     child (small geometry) if the TPU child dies or times out."""
-    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
-    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
-    me = os.path.abspath(__file__)
-
-    out = None
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        out = bench._run_child({}, tpu_timeout, script=me)
-        if out is not None and out.get("platform") == "cpu":
-            bench.log("TPU child self-degraded to CPU")
-    if out is None:
-        bench.log("falling back to a CPU child (small geometry)")
-        out = bench._run_child(
-            {"JAX_PLATFORMS": "cpu", "GPT2_BENCH_SMALL": "1",
-             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform"
-                             "_device_count=8").strip()},
-            cpu_timeout, script=me)
+    out = bench.run_orchestrated("GPT2_BENCH_SMALL",
+                                 script=os.path.abspath(__file__))
     if out is None:
         out = {"metric": "persona_gpt2s_sketch_round_time",
                "value": None, "unit": "ms/round", "vs_baseline": None,
@@ -242,13 +227,5 @@ def orchestrate() -> int:
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_IS_WORKER") == "1":
-        budget = os.environ.get("BENCH_CHILD_BUDGET")
-        if budget:
-            # alarm_guard clamps every stage to this child-wide budget
-            bench._DEADLINE = time.time() + int(budget)
-        try:
-            raise SystemExit(main())
-        except bench.StageTimeout as e:
-            bench.log(f"FATAL: stage timed out: {e}")
-            raise SystemExit(3)
+        raise SystemExit(bench.worker_entry(main))
     raise SystemExit(orchestrate())

@@ -27,25 +27,32 @@ import os
 import sys
 import time
 
-import jax
-
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-import jax.numpy as jnp
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from commefficient_tpu.config import Config
-from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
+import bench  # repo-root harness: child orchestration + backend bring-up
 
-enable_persistent_compilation_cache()
-from commefficient_tpu.federated import round as fround
-from commefficient_tpu.models import ResNet9
-from commefficient_tpu.ops.flat import flatten_params, masked_topk
-from commefficient_tpu.ops.sketch import CSVec
-from commefficient_tpu.parallel.mesh import make_client_mesh
+if os.environ.get("BENCH_IS_WORKER") == "1":
+    # heavy imports (jax, the package, the XLA-cache mkdir) belong to
+    # the measuring child only; the orchestrating parent just runs
+    # subprocesses (same split as bench.py/bench_gpt2.py)
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.utils.cache import \
+        enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+    from commefficient_tpu.federated import round as fround
+    from commefficient_tpu.models import ResNet9
+    from commefficient_tpu.ops.flat import flatten_params, masked_topk
+    from commefficient_tpu.ops.sketch import CSVec
+    from commefficient_tpu.parallel.mesh import make_client_mesh
 
 NUM_WORKERS = 8
 LOCAL_BATCH = 32
@@ -81,18 +88,25 @@ def timeit(fn, *args, reps=REPS):
 
 
 def main():
-    platform = jax.devices()[0].platform
+    # the tunnel's first jax.devices() can hang a fresh process for
+    # >15 min; bench.acquire_backend retries under SIGALRM and degrades
+    # to CPU instead of wedging the whole profile
+    _, platform = bench.acquire_backend()
+    # a backend that self-degraded to CPU must also degrade geometry:
+    # the full 6.6M-param sketch profile would grind on CPU until the
+    # parent's hard kill (bench.py main() makes the same choice)
+    small = SMALL or platform == "cpu"
     mesh = make_client_mesh(min(len(jax.devices()), NUM_WORKERS))
     channels = ({"prep": 8, "layer1": 8, "layer2": 8, "layer3": 8}
-                if SMALL else None)
+                if small else None)
     model = ResNet9(num_classes=10, channels=channels)
     x0 = jnp.zeros((LOCAL_BATCH, 32, 32, 3), jnp.float32)
     params = model.init(jax.random.PRNGKey(0), x0)
     vec, unravel = flatten_params(params)
     D = int(vec.shape[0])
     cfg = Config(
-        mode="sketch", k=500 if SMALL else 50_000, num_rows=5,
-        num_cols=max(256, D // 13) if SMALL else 500_000, num_blocks=20,
+        mode="sketch", k=500 if small else 50_000, num_rows=5,
+        num_cols=max(256, D // 13) if small else 500_000, num_blocks=20,
         error_type="virtual", virtual_momentum=0.9, local_momentum=0.0,
         weight_decay=5e-4, microbatch_size=-1, num_workers=NUM_WORKERS,
         num_clients=10 * NUM_WORKERS, grad_size=D,
@@ -127,12 +141,13 @@ def main():
            "stages_ms": {}}
 
     class Stages(dict):
-        # print incrementally: each stage involves a slow TPU compile,
-        # so a hang/timeout should still leave the completed stages
-        # on stdout
+        # print each stage as it completes, to stderr: the parent
+        # (_run_child) relays the stderr tail even for a hung/killed
+        # child, so a mid-profile death still leaves the completed
+        # stages visible, and stdout stays clean for the JSON line
         def __setitem__(self, k2, v):
             super().__setitem__(k2, round(v, 2))
-            print(f"  {k2}: {v:.2f} ms", flush=True)
+            print(f"  {k2}: {v:.2f} ms", file=sys.stderr, flush=True)
 
     S = out["stages_ms"] = Stages()
 
@@ -189,8 +204,27 @@ def main():
                                          key), reps=max(2, REPS // 2))
     S["scanned_round_per_round"] = t_scan / ROUNDS
 
-    print(json.dumps(out, indent=1))
+    print(json.dumps(out), flush=True)
+
+
+def orchestrate() -> int:
+    """Parent: run main() in a hard-killed child (the only watchdog
+    that works when the tunnel hangs inside C++ — SIGALRM is not
+    delivered; same split as bench.py/bench_gpt2.py), degrading to a
+    small-geometry CPU child if the TPU child dies or times out."""
+    out = bench.run_orchestrated(
+        "PROF_SMALL", script=os.path.abspath(__file__),
+        tpu_timeout=int(os.environ["PROF_TPU_TIMEOUT"])
+        if "PROF_TPU_TIMEOUT" in os.environ else None,
+        cpu_timeout=int(os.environ["PROF_CPU_TIMEOUT"])
+        if "PROF_CPU_TIMEOUT" in os.environ else None)
+    if out is None:
+        out = {"error": "all profile children failed or timed out"}
+    print(json.dumps(out, indent=1), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_IS_WORKER") == "1":
+        sys.exit(bench.worker_entry(main))
+    sys.exit(orchestrate())

@@ -95,7 +95,20 @@ class FedDataset:
             return per
         out = []
         n_units = len(self.images_per_client)
-        per_unit = self._num_clients // n_units if self._num_clients else 1
+        per_unit = (self._num_clients // n_units
+                    if self._num_clients is not None else 1)
+        if per_unit < 1 or (self._num_clients is not None
+                            and self._num_clients % n_units):
+            # the reference dies with a bare ZeroDivisionError below the
+            # unit count and silently builds a partition shorter than
+            # num_clients for non-multiples (fed_dataset.py:42-44, then
+            # an IndexError downstream); fail with an actionable message
+            raise ValueError(
+                f"non-IID partition needs num_clients to be a positive "
+                f"multiple of the natural unit count ({n_units}; one "
+                f"class/writer/persona per unit), got "
+                f"num_clients={self._num_clients}. Use a multiple of "
+                f"{n_units}, or --iid.")
         for n_images in self.images_per_client:
             counts = [n_images // per_unit] * per_unit
             counts[-1] += n_images % per_unit

@@ -38,6 +38,17 @@ def test_resharding_num_clients(tmp_path):
     assert np.all(labels_a == 3) and np.all(labels_b == 3)
 
 
+def test_too_few_clients_for_natural_partition_is_actionable(tmp_path):
+    # num_clients below (or not a multiple of) the natural unit count
+    # (10 CIFAR classes) is a clear ValueError here, not the reference's
+    # bare ZeroDivisionError / downstream IndexError (fed_dataset.py:42-44)
+    for bad in (8, 15):
+        ds = FedCIFAR10(str(tmp_path), num_clients=bad,
+                        synthetic_examples=(500, 100))
+        with pytest.raises(ValueError, match="natural unit count"):
+            ds.data_per_client
+
+
 def test_iid_shuffle_mixes_labels(tmp_path):
     ds = FedCIFAR10(str(tmp_path), do_iid=True, num_clients=10,
                     synthetic_examples=(500, 100))
