@@ -60,6 +60,61 @@ PEAK_TFLOPS = {
 }
 
 
+def make_run_digest(run):
+    """Jit a scanned-round runner `(server, clients, batches, lrs, key)
+    -> (server', clients', metrics, bits)` into a single-f32-scalar
+    digest: every output feeds the scalar (nothing DCE-able), and the
+    sync transfer is 4 bytes — the measurement discipline all benches
+    share (see PERF.md 'Measurement rules')."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def digest(server, clients, batches, lrs, key):
+        server2, clients2, m, bits = run(server, clients, batches, lrs,
+                                         key)
+        leaves = [l for l in jax.tree.leaves(clients2) if l.size > 0]
+        client_digest = sum([l.reshape(-1)[0] for l in leaves],
+                            jnp.float32(0))
+        return (m.losses.mean() + server2.ps_weights[0]
+                + bits.sum(dtype=jnp.uint32).astype(jnp.float32)
+                + client_digest)
+    return digest
+
+
+def add_flops_fields(out, flops_per_round, round_ms, device_kind):
+    """Fold flops/TFLOP/s/MFU into a bench JSON dict (shared reporting
+    rules: MFU against the chip's bf16 peak from PEAK_TFLOPS)."""
+    if not flops_per_round:
+        return
+    tflops_per_s = flops_per_round / (round_ms / 1e3) / 1e12
+    out["flops_per_round"] = flops_per_round
+    out["tflops_per_s"] = round(tflops_per_s, 3)
+    peak = next((v for k, v in PEAK_TFLOPS.items()
+                 if k.lower() in device_kind.lower()), None)
+    if peak:
+        out["mfu"] = round(tflops_per_s / peak, 4)
+
+
+def ce_loss_fn(model):
+    """Masked cross-entropy + accuracy loss in the framework's
+    `(params, batch, mask) -> (loss, (metrics,))` contract, shared by
+    the CV-shaped benches."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, batch, mask):
+        xb, yb = batch
+        logits = model.apply(params, xb)
+        logp = jax.nn.log_softmax(logits)
+        per_ex = -jnp.take_along_axis(logp, yb[:, None], axis=1)[:, 0]
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per_ex * mask).sum() / denom
+        acc = ((logits.argmax(-1) == yb) * mask).sum() / denom
+        return loss, (acc,)
+    return loss_fn
+
+
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
@@ -186,33 +241,13 @@ def main() -> int:
         do_bf16=os.environ.get("BENCH_BF16", "") == "1",
     ).validate()
 
-    def loss_fn(params, batch, mask):
-        xb, yb = batch
-        logits = model.apply(params, xb)
-        logp = jax.nn.log_softmax(logits)
-        per_ex = -jnp.take_along_axis(logp, yb[:, None], axis=1)[:, 0]
-        denom = jnp.maximum(mask.sum(), 1.0)
-        loss = (per_ex * mask).sum() / denom
-        acc = ((logits.argmax(-1) == yb) * mask).sum() / denom
-        return loss, (acc,)
+    loss_fn = ce_loss_fn(model)
 
     def build_digest(cfg_variant):
-        """Jitted scanned-round digest for a config variant: every
-        output feeds ONE scalar (nothing DCE-able, one 4-byte sync)."""
+        """Single-scalar digest for a config variant (make_run_digest
+        holds the shared anti-DCE / one-sync rules)."""
         tr = fround.make_train_fn(loss_fn, unravel, cfg_variant, mesh)
-        run_variant = tr.train_rounds
-
-        @jax.jit
-        def digest(server, clients, batches, lrs, key):
-            server2, clients2, m, bits = run_variant(
-                server, clients, batches, lrs, key)
-            leaves = [l for l in jax.tree.leaves(clients2) if l.size > 0]
-            client_digest = sum([l.reshape(-1)[0] for l in leaves],
-                                jnp.float32(0))
-            return (m.losses.mean() + server2.ps_weights[0]
-                    + bits.sum(dtype=jnp.uint32).astype(jnp.float32)
-                    + client_digest)
-        return digest
+        return make_run_digest(tr.train_rounds)
 
     server = fround.init_server_state(cfg, vec)
     clients = fround.init_client_state(cfg, cfg.resolved_num_clients(),
@@ -341,14 +376,7 @@ def main() -> int:
     if bf16_round_ms is not None:
         out["value_bf16"] = round(bf16_round_ms, 3)
         out["vs_baseline_bf16"] = round(ref_round_ms / bf16_round_ms, 3)
-    if flops_per_round:
-        tflops_per_s = flops_per_round / (round_ms / 1e3) / 1e12
-        out["flops_per_round"] = flops_per_round
-        out["tflops_per_s"] = round(tflops_per_s, 3)
-        peak = next((v for k, v in PEAK_TFLOPS.items()
-                     if k.lower() in device_kind.lower()), None)
-        if peak:
-            out["mfu"] = round(tflops_per_s / peak, 4)
+    add_flops_fields(out, flops_per_round, round_ms, device_kind)
     print(json.dumps(out), flush=True)
     return 0
 

@@ -1,26 +1,32 @@
-"""Benchmark: wall-clock per federated round at GPT2 scale.
+"""Benchmark: wall-clock per federated round for BASELINE config #3.
 
-BASELINE config #5: GPT2-small double-heads (124M params) on
-PersonaChat-shaped data, count-sketch compression + virtual momentum.
-This is the regime where MFU stops being dominated by round overhead
-(VERDICT r2 next #3): the transformer fwd/bwd is ~0.5 TFLOP/round at
-the shapes below, vs ResNet9/CIFAR's 0.05.
+ResNet-18 (the PreAct variant with StatelessBatchNorm — see
+models/fixup_resnet.py; the norm-free Fixup variant is FixupResNet18,
+not what is measured here) on CIFAR100-shaped data, `local_topk`
+compression with per-client local error feedback and
+local momentum, 100 non-IID clients with 8 participating per round —
+the reference entry point is `cv_train.py --mode local_topk
+--error_type local` (BASELINE.md configs table).
 
-Same measurement discipline as the repo-root bench.py (whose
-machinery this reuses): the measurement runs in a CHILD process under
-a hard kill-on-timeout (bench._run_child on this file — SIGALRM alone
-cannot interrupt a TPU tunnel hung inside C++), backend retry with CPU
-degrade, ONE jitted scalar digest per measurement so the axon tunnel's
-~70 ms/transfer sync cost and XLA DCE cannot distort the number,
-analytic reference stand-in = num_workers x a measured single-client
-serialized fwd/bwd on the same chip (the reference serializes clients
-per GPU, fed_worker.py:60).
+local_topk stresses a different path than the headline sketch bench:
+no sketch encode/decode at all, but per-participant `masked_topk` on
+the [D] gradient (ops/flat.py — the approx_max_k selection path) and
+gather/scatter of the participants' rows of the [num_clients, D] error
+and velocity state (federated/round.py) — at 100 clients x 11M params
+that state is the memory hazard SURVEY §7.3 ranks third.
+
+Same measurement discipline as bench.py / bench_gpt2.py, whose
+machinery this reuses: child process under hard kill-on-timeout, one
+jitted scalar digest (no DCE, one 4-byte sync), analytic reference
+stand-in = num_workers x a measured single-client serialized fwd/bwd
+on the same chip (the reference serializes clients per GPU,
+fed_worker.py:60).
 
 Writes one JSON line to stdout:
-  {"metric": "persona_gpt2s_sketch_round_time", "value": .., ...}
+  {"metric": "cifar100_resnet18_local_topk_round_time", ...}
 
-Usage:  python benchmarks/bench_gpt2.py                (TPU if up)
-        JAX_PLATFORMS=cpu GPT2_BENCH_SMALL=1 python benchmarks/bench_gpt2.py
+Usage:  python benchmarks/bench_local_topk.py            (TPU if up)
+        JAX_PLATFORMS=cpu LTK_BENCH_SMALL=1 python benchmarks/bench_local_topk.py
 """
 from __future__ import annotations
 
@@ -31,14 +37,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import bench  # repo-root harness: log/alarm_guard/acquire_backend/PEAK_TFLOPS
+import bench  # repo-root harness: orchestration, backend bring-up, logging
 
-NUM_WORKERS = int(os.environ.get("GPT2_BENCH_WORKERS", "4"))
-LOCAL_BATCH = int(os.environ.get("GPT2_BENCH_BATCH", "4"))
-ROUNDS = int(os.environ.get("GPT2_BENCH_ROUNDS", "4"))
-SEQ_LEN = int(os.environ.get("GPT2_BENCH_SEQ", "128"))
-CANDS = 2
-SMALL = os.environ.get("GPT2_BENCH_SMALL", "") == "1"
+NUM_WORKERS = int(os.environ.get("LTK_BENCH_WORKERS", "8"))
+LOCAL_BATCH = int(os.environ.get("LTK_BENCH_BATCH", "32"))
+ROUNDS = int(os.environ.get("LTK_BENCH_ROUNDS", "10"))
+NUM_CLIENTS = int(os.environ.get("LTK_BENCH_CLIENTS", "100"))
+SMALL = os.environ.get("LTK_BENCH_SMALL", "") == "1"
 STAGE_TIMEOUT = int(os.environ.get("BENCH_STAGE_TIMEOUT", "900"))
 
 
@@ -54,49 +59,41 @@ def main() -> int:
 
     from commefficient_tpu.config import Config
     from commefficient_tpu.federated import round as fround
-    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.models import build_model
     from commefficient_tpu.ops.flat import flatten_params
     from commefficient_tpu.parallel.mesh import make_client_mesh
-    from commefficient_tpu.training.gpt2_train import (
-        make_compute_loss_train,
-    )
 
     device_kind = jax.devices()[0].device_kind
     mesh = make_client_mesh(min(len(jax.devices()), NUM_WORKERS))
 
     small = SMALL or platform == "cpu"
+    num_classes = 100
     if small:
-        gcfg = GPT2Config(vocab_size=5005, n_positions=max(SEQ_LEN, 64),
-                          n_embd=64, n_layer=2, n_head=2)
+        model_mod = build_model("ResNet9", num_classes=num_classes,
+                                channels={"prep": 8, "layer1": 8,
+                                          "layer2": 8, "layer3": 8})
     else:
-        # GPT2-small sized for the PersonaChat tokenizer (50257 + 5
-        # special tokens, data/persona.py)
-        gcfg = GPT2Config(vocab_size=50262,
-                          n_positions=max(SEQ_LEN, 128))
-    module = GPT2DoubleHeads(gcfg)
+        model_mod = build_model("ResNet18", num_classes=num_classes)
 
     key = jax.random.PRNGKey(0)
-    x0 = jnp.zeros((1, CANDS, SEQ_LEN), jnp.int32)
-    params = module.init(key, x0, x0, jnp.zeros((1, CANDS), jnp.int32))
+    x0 = jnp.zeros((LOCAL_BATCH, 32, 32, 3), jnp.float32)
+    params = model_mod.init(key, x0)
     vec, unravel = flatten_params(params)
     D = int(vec.shape[0])
-    bench.log(f"gpt2 bench D={D} small={small} rounds={ROUNDS} "
-              f"W={NUM_WORKERS} B={LOCAL_BATCH} L={SEQ_LEN}")
+    num_clients = 20 if small else NUM_CLIENTS
+    bench.log(f"local_topk bench D={D} small={small} rounds={ROUNDS} "
+              f"W={NUM_WORKERS} B={LOCAL_BATCH} clients={num_clients}")
 
     cfg = Config(
-        mode="sketch",
-        # the reference flagship geometry RATIOS scaled to this D
-        # (utils.py:142-145 is 5 x 500k at D=6.6M -> ~13 coords/cell)
-        k=max(D // 130, 1000),
-        num_rows=5,
-        num_cols=max(D // 13, 10_000),
-        num_blocks=20, error_type="virtual", virtual_momentum=0.9,
-        local_momentum=0.0, weight_decay=0.0, microbatch_size=-1,
-        num_workers=NUM_WORKERS, num_clients=10 * NUM_WORKERS,
-        grad_size=D, lm_coef=1.0, mc_coef=1.0,
+        mode="local_topk", error_type="local", local_momentum=0.9,
+        virtual_momentum=0.0,
+        k=max(D // 130, 500),  # reference default ratio: 50k at D=6.6M
+        weight_decay=5e-4, microbatch_size=-1, num_workers=NUM_WORKERS,
+        num_clients=num_clients, local_batch_size=LOCAL_BATCH,
+        grad_size=D,
     ).validate()
 
-    loss_fn = make_compute_loss_train(module, cfg)
+    loss_fn = bench.ce_loss_fn(model_mod)
 
     train_round = fround.make_train_fn(loss_fn, unravel, cfg, mesh)
     server = fround.init_server_state(cfg, vec)
@@ -104,25 +101,23 @@ def main() -> int:
                                        vec, mesh=mesh)
 
     rng = np.random.RandomState(0)
-    V = gcfg.vocab_size
-
-    def tok(shape, hi):
-        return jnp.asarray(rng.randint(0, hi, shape).astype(np.int32))
-
     W, B = NUM_WORKERS, LOCAL_BATCH
-    input_ids = tok((W, B, CANDS, SEQ_LEN), V)
-    mc_token_ids = tok((W, B, CANDS), SEQ_LEN)
-    lm_labels = tok((W, B, CANDS, SEQ_LEN), V)
-    mc_labels = tok((W, B), CANDS)
-    token_type_ids = tok((W, B, CANDS, SEQ_LEN), V)
-    data = (input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids)
+    x = jnp.asarray(rng.randn(W, B, 32, 32, 3).astype(np.float32))
+    y = jnp.asarray(
+        rng.randint(0, num_classes, (W, B)).astype(np.int32))
     mask = jnp.ones((W, B), jnp.float32)
+    data = (x, y)
 
+    # distinct participants each round, cycling the 100 clients — the
+    # gather/scatter of participant state rows is part of the cost
+    # being measured
+    cids = np.stack([(np.arange(W) + r * W) % num_clients
+                     for r in range(ROUNDS)]).astype(np.int32)
     batches = fround.RoundBatch(
-        jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (ROUNDS, W)),
+        jnp.asarray(cids),
         tuple(jnp.broadcast_to(d, (ROUNDS,) + d.shape) for d in data),
         jnp.broadcast_to(mask, (ROUNDS, W, B)))
-    lrs = jnp.full((ROUNDS,), 4e-2)
+    lrs = jnp.full((ROUNDS,), 0.1)
     run_digest = bench.make_run_digest(train_round.train_rounds)
 
     t0 = time.time()
@@ -152,32 +147,31 @@ def main() -> int:
         round_ms = float(np.median(reps)) / ROUNDS * 1e3
 
     # analytic reference stand-in: per-client serialized fwd/bwd
-    def one_client_step(params_vec, d):
+    def one_client_step(params_vec, xb, yb):
         def loss(v):
-            l, _ = loss_fn(unravel(v),
-                           tuple(x[0] for x in d), mask[0])
+            l, _ = loss_fn(unravel(v), (xb, yb), mask[0])
             return l
         return jax.grad(loss)(params_vec)
 
     @jax.jit
-    def serial_steps(params_vec, d):
+    def serial_steps(params_vec, xb, yb):
         def body(v, _):
-            return v - 1e-6 * one_client_step(v, d), None
+            return v - 1e-6 * one_client_step(v, xb, yb), None
         v, _ = jax.lax.scan(body, params_vec, None, length=ROUNDS)
         return v.sum()
 
     with bench.alarm_guard(STAGE_TIMEOUT, "baseline measure"):
-        float(np.asarray(serial_steps(vec, data)))
+        float(np.asarray(serial_steps(vec, x[0], y[0])))
         reps = []
         for _ in range(3):
             t0 = time.perf_counter()
-            float(np.asarray(serial_steps(vec, data)))
+            float(np.asarray(serial_steps(vec, x[0], y[0])))
             reps.append(time.perf_counter() - t0)
         ref_round_ms = (float(np.median(reps)) / ROUNDS * 1e3
                         * NUM_WORKERS)
 
     out = {
-        "metric": "persona_gpt2s_sketch_round_time",
+        "metric": "cifar100_resnet18_local_topk_round_time",
         "value": round(round_ms, 3),
         "unit": "ms/round",
         "vs_baseline": round(ref_round_ms / round_ms, 3),
@@ -185,8 +179,8 @@ def main() -> int:
         "device_kind": device_kind,
         "num_workers": NUM_WORKERS,
         "local_batch": LOCAL_BATCH,
-        "seq_len": SEQ_LEN,
-        "num_candidates": CANDS,
+        "num_clients": num_clients,
+        "k": cfg.k,
         "grad_size": D,
     }
     bench.add_flops_fields(out, flops_per_round, round_ms, device_kind)
@@ -197,10 +191,10 @@ def main() -> int:
 def orchestrate() -> int:
     """Parent: run main() in a hard-killed child, degrading to a CPU
     child (small geometry) if the TPU child dies or times out."""
-    out = bench.run_orchestrated("GPT2_BENCH_SMALL",
+    out = bench.run_orchestrated("LTK_BENCH_SMALL",
                                  script=os.path.abspath(__file__))
     if out is None:
-        out = {"metric": "persona_gpt2s_sketch_round_time",
+        out = {"metric": "cifar100_resnet18_local_topk_round_time",
                "value": None, "unit": "ms/round", "vs_baseline": None,
                "error": "all bench children failed or timed out"}
     print(json.dumps(out), flush=True)

@@ -9,11 +9,13 @@ instead of suspicion.
 
 Stages timed (bench geometry: ResNet9 D=6.57M, 5x500k sketch, k=50k,
 8 clients x batch 32):
+  null_dispatch    a scalar add — the tunnel's per-dispatch floor
   client_fwd_bwd   8 clients' vmapped fwd/bwd, no compression
   encode           8 clients' vmapped sketch encode [D] -> [5, 500k]
   decode_topk      server decode_topk_sparse(table, k)
   encode_sparse    server re-sketch of the k-sparse update
   masked_topk      dense top-k on [D] (true_topk/local_topk path)
+  pack_change_bits accounting bitset pack (f32-dot reformulation)
   full_round       one train round (single, unscanned)
   scanned_round    per-round time of the 10-round scanned program
 
@@ -68,8 +70,16 @@ def scalarize(fn):
     swamps the measurement)."""
     def wrapped(*args):
         out = fn(*args)
-        return sum(jnp.sum(l) for l in jax.tree.leaves(out)
-                   if jnp.issubdtype(l.dtype, jnp.floating))
+        acc = jnp.float32(0)
+        for l in jax.tree.leaves(out):
+            if jnp.issubdtype(l.dtype, jnp.floating):
+                acc = acc + jnp.sum(l)
+            else:
+                # integer outputs (e.g. the uint32 change bitset) must
+                # be consumed too, or XLA deletes the work that
+                # produced them from the timed program
+                acc = acc + jnp.sum(l, dtype=jnp.uint32).astype(jnp.float32)
+        return acc
     return jax.jit(wrapped)
 
 
@@ -114,14 +124,7 @@ def main():
     sketch = CSVec(d=D, c=cfg.num_cols, r=cfg.num_rows,
                    num_blocks=cfg.num_blocks, seed=42)
 
-    def loss_fn(p, batch, mask):
-        xb, yb = batch
-        logits = model.apply(p, xb)
-        logp = jax.nn.log_softmax(logits)
-        per_ex = -jnp.take_along_axis(logp, yb[:, None], axis=1)[:, 0]
-        denom = jnp.maximum(mask.sum(), 1.0)
-        return (per_ex * mask).sum() / denom, \
-            (((logits.argmax(-1) == yb) * mask).sum() / denom,)
+    loss_fn = bench.ce_loss_fn(model)
 
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(NUM_WORKERS, LOCAL_BATCH, 32, 32, 3)
@@ -180,6 +183,10 @@ def main():
     # --- dense top-k (true/local_topk path) ----------------------------
     S["masked_topk"] = timeit(
         jax.jit(lambda g: masked_topk(g, cfg.k)), gvec)
+
+    # --- accounting bit-pack (the f32-dot reformulation) ---------------
+    from commefficient_tpu.federated.accounting import pack_change_bits
+    S["pack_change_bits"] = timeit(jax.jit(pack_change_bits), gvec)
 
     # --- full round ----------------------------------------------------
     train_round = fround.make_train_fn(loss_fn, unravel, cfg, mesh)
