@@ -109,6 +109,11 @@ class Config:
     # process per GPU): >1 lays devices out as (clients, model) and
     # GSPMD-partitions each client's fwd/bwd per parallel/tp.py
     model_parallel: int = 1
+    # lay the clients axis slice-major over DCN (emulated grouping off
+    # real multi-slice hardware; parallel/mesh.py
+    # make_multihost_client_mesh). 1 = flat single-slice mesh; real
+    # slice topology is auto-detected either way
+    num_slices: int = 1
     # run client forward/backward in bfloat16 (f32 master weights and
     # f32 server/compression state; see client.make_flat_grad_fn) —
     # the MXU's fast path, an extension over the reference's fp32 CUDA
@@ -306,6 +311,10 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--model_parallel", type=int, default=1,
                    help="tensor-parallel degree over the mesh's model "
                         "axis (GPT2-scale models; parallel/tp.py)")
+    p.add_argument("--num_slices", type=int, default=1,
+                   help="slice-major clients layout over DCN "
+                        "(emulated when devices report no slice "
+                        "topology; parallel/mesh.py)")
     p.add_argument("--bf16", action="store_true", dest="do_bf16",
                    help="bfloat16 client fwd/bwd (f32 master weights)")
     p.add_argument("--remat", action="store_true", dest="do_remat",

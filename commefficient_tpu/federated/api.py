@@ -44,7 +44,7 @@ from commefficient_tpu.federated.accounting import (
     CommAccountant, pack_change_bits,
 )
 from commefficient_tpu.ops.flat import flatten_params
-from commefficient_tpu.parallel.mesh import make_client_mesh
+from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 
 
 class FedModel:
@@ -74,7 +74,15 @@ class FedModel:
             n = min(len(jax.devices()), max(cfg.num_workers, 1))
             while cfg.num_workers % n:
                 n -= 1
-            mesh = make_client_mesh(n)
+            # slice-major DCN layout: real multi-slice topology is
+            # auto-detected; --num_slices > 1 emulates the grouping on
+            # single-slice/CPU devices (and on real multi-slice
+            # hardware must match the physical count); the flat
+            # single-slice mesh is the default case of the same call
+            mesh = make_multihost_client_mesh(
+                devices=jax.devices()[:n],
+                num_slices=cfg.num_slices if cfg.num_slices > 1
+                else None)
         self.mesh = mesh
         self.num_clients = cfg.resolved_num_clients(num_clients)
 

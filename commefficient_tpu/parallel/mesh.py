@@ -70,9 +70,17 @@ def make_multihost_client_mesh(model_parallel: int = 1,
     clients = n // model_parallel
 
     real_slices = {getattr(d, "slice_index", 0) or 0 for d in devices}
-    if num_slices is None and len(real_slices) > 1:
-        from jax.experimental import mesh_utils
+    if len(real_slices) > 1:
+        # real multi-slice topology always wins: emulating a DIFFERENT
+        # slice count would interleave devices of distinct physical
+        # slices into one group and put DCN hops inside the supposedly
+        # intra-slice inner dimension
         n_sl = len(real_slices)
+        if num_slices is not None and num_slices != n_sl:
+            raise ValueError(
+                f"num_slices={num_slices} but the devices report "
+                f"{n_sl} physical slices")
+        from jax.experimental import mesh_utils
         if clients % n_sl:
             raise ValueError(f"clients axis {clients} not divisible by "
                              f"{n_sl} slices")

@@ -38,7 +38,7 @@ from commefficient_tpu.models.gpt2 import (
     resize_position_embeddings, resize_token_embeddings, save_pretrained,
     try_load_pretrained,
 )
-from commefficient_tpu.parallel.mesh import make_client_model_mesh
+from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 from commefficient_tpu.parallel.tp import tp_loss
 from commefficient_tpu.training.scanloop import run_scanned_rounds
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
@@ -381,11 +381,16 @@ def main(argv=None) -> bool:
     mesh = None
     if cfg.model_parallel > 1:
         # (clients, model) mesh: manual DP over clients, GSPMD tensor
-        # parallelism over the model axis (parallel/tp.py)
+        # parallelism over the model axis (parallel/tp.py); slice-major
+        # clients layout auto-detected or emulated via --num_slices
+        # (parallel/mesh.py), so TP activation collectives stay on ICI
         shards = max(len(jax.devices()) // cfg.model_parallel, 1)
         while cfg.num_workers % shards:
             shards -= 1
-        mesh = make_client_model_mesh(shards, cfg.model_parallel)
+        mesh = make_multihost_client_mesh(
+            model_parallel=cfg.model_parallel,
+            devices=jax.devices()[:shards * cfg.model_parallel],
+            num_slices=cfg.num_slices if cfg.num_slices > 1 else None)
         loss_train = tp_loss(loss_train, mesh)
         loss_val = tp_loss(loss_val, mesh)
         print(f"tensor parallel: mesh {dict(mesh.shape)}")

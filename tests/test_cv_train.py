@@ -32,6 +32,14 @@ def test_smoke_uncompressed_scan_rounds(tmp_path):
     assert run_main(tmp_path, "--mode", "uncompressed", "--scan_rounds")
 
 
+def test_smoke_multislice(tmp_path):
+    # --num_slices 2: the round runs on the slice-major (emulated DCN)
+    # device layout end to end (parallel/mesh.py)
+    assert run_main(tmp_path, "--mode", "sketch",
+                    "--error_type", "virtual",
+                    "--virtual_momentum", "0.9", "--num_slices", "2")
+
+
 def test_smoke_bf16(tmp_path):
     assert run_main(tmp_path, "--mode", "sketch",
                     "--error_type", "virtual",

@@ -98,6 +98,13 @@ def test_smoke_tensor_parallel(tmp_path):
                     "--model_parallel", "2")
 
 
+def test_smoke_tensor_parallel_multislice(tmp_path):
+    """--model_parallel 2 --num_slices 2: TP on the slice-major
+    (emulated DCN) clients layout (parallel/mesh.py)."""
+    assert run_main(tmp_path, "--mode", "uncompressed",
+                    "--model_parallel", "2", "--num_slices", "2")
+
+
 def test_checkpoint_and_resume(tmp_path):
     ck = str(tmp_path / "ck")
     assert run_main(tmp_path, "--mode", "uncompressed",
