@@ -97,7 +97,8 @@ def run_mode(mode: str, train_set, val_set, seed=0):
     from commefficient_tpu.ops.flat import flatten_params
     D = int(flatten_params(params)[0].shape[0])
 
-    base = dict(seed=seed, num_workers=WORKERS, local_batch_size=BATCH,
+    base = dict(seed=seed, num_workers=WORKERS,
+                local_batch_size=(-1 if mode == "fedavg" else BATCH),
                 weight_decay=5e-4, microbatch_size=-1,
                 num_epochs=float(EPOCHS))
     # Peak LR is tuned PER MODE, as the paper's grid searches are
@@ -106,7 +107,8 @@ def run_mode(mode: str, train_set, val_set, seed=0):
     # compressed modes see ~1/(1-rho) less effective step than the
     # uncompressed control at the same lr — measured flat-at-chance
     # until compensated.
-    peak_lr = {"sketch": 2.4, "local_topk": 1.6, "uncompressed": 0.4}[mode]
+    peak_lr = {"sketch": 2.4, "local_topk": 1.6, "uncompressed": 0.4,
+               "fedavg": 0.4}[mode]
     if mode == "sketch":
         # the reference's flagship geometry RATIOS (utils.py defaults:
         # D=6.6M -> 5 x 500k, ~13 coords/cell): r*c = D/2.6, k = D/50.
@@ -118,6 +120,14 @@ def run_mode(mode: str, train_set, val_set, seed=0):
                      virtual_momentum=0.9, local_momentum=0.0,
                      num_rows=5, num_cols=max(D // 13, 256), num_blocks=1,
                      k=max(D // 50, 64), **base)
+    elif mode == "fedavg":
+        # the paper's FedAvg baseline: whole-client local SGD at the
+        # server's LR, weighted weight-delta aggregation with virtual
+        # momentum at lr=1 (reference fed_worker.py:61-113)
+        cfg = Config(mode="fedavg", error_type="none",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     num_fedavg_epochs=1, fedavg_batch_size=BATCH,
+                     **base)
     elif mode == "local_topk":
         # upload = k floats -> 50x per-round upload compression
         cfg = Config(mode="local_topk", error_type="local",
@@ -127,7 +137,8 @@ def run_mode(mode: str, train_set, val_set, seed=0):
         cfg = Config(mode="uncompressed", error_type="virtual",
                      virtual_momentum=0.9, local_momentum=0.0, **base)
 
-    loader = FedLoader(train_set, WORKERS, BATCH, seed=seed)
+    loader = FedLoader(train_set, WORKERS, cfg.local_batch_size,
+                       seed=seed)
     val_loader = FedValLoader(val_set, 64,
                               num_shards=min(jax.device_count(), WORKERS))
     model = FedModel(None, make_compute_loss(model_mod), cfg,
@@ -184,7 +195,8 @@ def main():
                    "platform": jax.devices()[0].platform,
                    "num_clients": int(train_set.num_clients)},
         "runs": [run_mode(m, train_set, val_set)
-                 for m in ("sketch", "uncompressed", "local_topk")],
+                 for m in ("sketch", "uncompressed", "local_topk",
+                           "fedavg")],
     }
     results["wall_clock_s"] = round(time.time() - t0, 1)
 
@@ -192,6 +204,7 @@ def main():
     sk = by_mode["sketch"]["curve"][-1]
     un = by_mode["uncompressed"]["curve"][-1]
     lt = by_mode["local_topk"]["curve"][-1]
+    fa = by_mode["fedavg"]["curve"][-1]
     un_floats = by_mode["uncompressed"]["upload_floats_per_client_round"]
     sk_ratio = un_floats / by_mode["sketch"]["upload_floats_per_client_round"]
     lt_ratio = un_floats / by_mode["local_topk"]["upload_floats_per_client_round"]
@@ -199,6 +212,7 @@ def main():
         "sketch_final_acc": sk["test_acc"],
         "uncompressed_final_acc": un["test_acc"],
         "local_topk_final_acc": lt["test_acc"],
+        "fedavg_final_acc": fa["test_acc"],
         "sketch_upload_compression_x": round(sk_ratio, 2),
         "local_topk_upload_compression_x": round(lt_ratio, 2),
     }
@@ -214,6 +228,7 @@ def main():
     assert lt["test_acc"] > un["test_acc"] - 0.1, \
         "local_topk fell far behind uncompressed"
     assert lt_ratio >= 10, "local_topk upload not >=10x compressed"
+    assert fa["test_acc"] > 0.5, "fedavg failed to learn"
     print("convergence-under-compression: OK")
 
 
