@@ -82,6 +82,37 @@ def make_run_digest(run):
     return digest
 
 
+def cost_flops(jitted, args, rounds):
+    """Per-round FLOPs of an already-compiled jitted call from XLA's
+    cost analysis (lower()/compile() hit the trace/executable caches),
+    or None when the backend can't report it."""
+    try:
+        with alarm_guard(STAGE_TIMEOUT, "cost analysis"):
+            cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        if cost and "flops" in cost:
+            return float(cost["flops"]) / rounds
+    except StageTimeout:
+        log("cost analysis timed out; omitting flops")
+    except Exception as e:
+        log(f"cost_analysis unavailable: {e}")
+    return None
+
+
+def median_ms(fn, args, divisor=1, reps=3):
+    """Median wall-clock of fn(*args) in ms / `divisor` (rounds per
+    call), syncing each rep through the 4-byte scalar transfer (the
+    only reliable sync on the tunnel — see PERF.md)."""
+    import numpy as np
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(np.asarray(fn(*args)))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) / divisor * 1e3
+
+
 def add_flops_fields(out, flops_per_round, round_ms, device_kind):
     """Fold flops/TFLOP/s/MFU into a bench JSON dict (shared reporting
     rules: MFU against the chip's bf16 peak from PEAK_TFLOPS)."""
@@ -285,31 +316,13 @@ def main() -> int:
         float(np.asarray(run_digest(server, clients, batches, lrs, key)))
     log(f"compile+first run: {time.time() - t0:.1f}s")
 
-    # FLOPs of the scanned program, for the MFU estimate. `run` is
-    # already jitted: lower() hits the trace cache and compile() hits
-    # the executable cache, so this reuses the first run's compile.
-    flops_per_round = None
-    try:
-        with alarm_guard(STAGE_TIMEOUT, "cost analysis"):
-            lowered = run_digest.lower(server, clients, batches, lrs, key)
-            cost = lowered.compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        if cost and "flops" in cost:
-            flops_per_round = float(cost["flops"]) / ROUNDS
-    except StageTimeout:
-        log("cost analysis timed out; omitting flops")
-    except Exception as e:
-        log(f"cost_analysis unavailable: {e}")
+    flops_per_round = cost_flops(
+        run_digest, (server, clients, batches, lrs, key), ROUNDS)
 
     with alarm_guard(STAGE_TIMEOUT, "measure"):
-        reps = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(np.asarray(run_digest(server, clients, batches, lrs,
-                                        key)))
-            reps.append(time.perf_counter() - t0)
-        round_ms = float(np.median(reps)) / ROUNDS * 1e3
+        round_ms = median_ms(run_digest,
+                             (server, clients, batches, lrs, key),
+                             divisor=ROUNDS)
 
     # analytic reference stand-in: per-client serialized fwd/bwd on
     # this same hardware (measured), x num_workers per round
@@ -328,14 +341,9 @@ def main() -> int:
         return v.sum()
 
     with alarm_guard(STAGE_TIMEOUT, "baseline measure"):
-        float(np.asarray(serial_steps(vec, x[0], y[0])))
-        reps = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(np.asarray(serial_steps(vec, x[0], y[0])))
-            reps.append(time.perf_counter() - t0)
-        ref_round_ms = (float(np.median(reps)) / ROUNDS * 1e3
-                        * NUM_WORKERS)
+        float(np.asarray(serial_steps(vec, x[0], y[0])))  # compile
+        ref_round_ms = median_ms(serial_steps, (vec, x[0], y[0]),
+                                 divisor=ROUNDS) * NUM_WORKERS
 
     # secondary measurement: the --bf16 round (TPU-native fast path;
     # f32 master weights). Reported as extra fields — the primary
@@ -347,14 +355,10 @@ def main() -> int:
             digest_bf16 = build_digest(cfg.replace(do_bf16=True))
             with alarm_guard(STAGE_TIMEOUT, "bf16 compile+measure"):
                 float(np.asarray(digest_bf16(server, clients, batches,
-                                             lrs, key)))
-                reps = []
-                for _ in range(3):
-                    t0 = time.perf_counter()
-                    float(np.asarray(digest_bf16(server, clients,
-                                                 batches, lrs, key)))
-                    reps.append(time.perf_counter() - t0)
-            bf16_round_ms = float(np.median(reps)) / ROUNDS * 1e3
+                                             lrs, key)))  # compile
+                bf16_round_ms = median_ms(
+                    digest_bf16, (server, clients, batches, lrs, key),
+                    divisor=ROUNDS)
         except StageTimeout:
             log("bf16 measurement timed out; omitting")
         except Exception as e:

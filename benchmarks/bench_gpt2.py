@@ -130,26 +130,13 @@ def main() -> int:
         float(np.asarray(run_digest(server, clients, batches, lrs, key)))
     bench.log(f"compile+first run: {time.time() - t0:.1f}s")
 
-    flops_per_round = None
-    try:
-        with bench.alarm_guard(STAGE_TIMEOUT, "cost analysis"):
-            lowered = run_digest.lower(server, clients, batches, lrs, key)
-            cost = lowered.compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        if cost and "flops" in cost:
-            flops_per_round = float(cost["flops"]) / ROUNDS
-    except Exception as e:
-        bench.log(f"cost_analysis unavailable: {e}")
+    flops_per_round = bench.cost_flops(
+        run_digest, (server, clients, batches, lrs, key), ROUNDS)
 
     with bench.alarm_guard(STAGE_TIMEOUT, "measure"):
-        reps = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(np.asarray(run_digest(server, clients, batches, lrs,
-                                        key)))
-            reps.append(time.perf_counter() - t0)
-        round_ms = float(np.median(reps)) / ROUNDS * 1e3
+        round_ms = bench.median_ms(
+            run_digest, (server, clients, batches, lrs, key),
+            divisor=ROUNDS)
 
     # analytic reference stand-in: per-client serialized fwd/bwd
     def one_client_step(params_vec, d):
@@ -167,14 +154,9 @@ def main() -> int:
         return v.sum()
 
     with bench.alarm_guard(STAGE_TIMEOUT, "baseline measure"):
-        float(np.asarray(serial_steps(vec, data)))
-        reps = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(np.asarray(serial_steps(vec, data)))
-            reps.append(time.perf_counter() - t0)
-        ref_round_ms = (float(np.median(reps)) / ROUNDS * 1e3
-                        * NUM_WORKERS)
+        float(np.asarray(serial_steps(vec, data)))  # compile
+        ref_round_ms = bench.median_ms(serial_steps, (vec, data),
+                                       divisor=ROUNDS) * NUM_WORKERS
 
     out = {
         "metric": "persona_gpt2s_sketch_round_time",

@@ -51,6 +51,7 @@ if os.environ.get("BENCH_IS_WORKER") == "1":
 
     enable_persistent_compilation_cache()
     from commefficient_tpu.federated import round as fround
+    from commefficient_tpu.federated.accounting import pack_change_bits
     from commefficient_tpu.models import ResNet9
     from commefficient_tpu.ops.flat import flatten_params, masked_topk
     from commefficient_tpu.ops.sketch import CSVec
@@ -185,7 +186,6 @@ def main():
         jax.jit(lambda g: masked_topk(g, cfg.k)), gvec)
 
     # --- accounting bit-pack (the f32-dot reformulation) ---------------
-    from commefficient_tpu.federated.accounting import pack_change_bits
     S["pack_change_bits"] = timeit(jax.jit(pack_change_bits), gvec)
 
     # --- full round ----------------------------------------------------
