@@ -69,9 +69,18 @@ class FedModel:
         self.cfg = cfg
 
         if mesh is None:
-            # widest mesh that divides num_workers (round_step shards
-            # the participating clients evenly across the mesh)
-            n = min(len(jax.devices()), max(cfg.num_workers, 1))
+            # widest clients axis that divides num_workers (round_step
+            # shards the participating clients evenly across the mesh),
+            # after reserving the model_parallel factor: with mp > 1
+            # the mesh carries a model axis (the engine replicates over
+            # it unless the loss is tp-wrapped, parallel/tp.py — see
+            # gpt2_train's TP branch for the wrapped path)
+            mp = max(cfg.model_parallel, 1)
+            if len(jax.devices()) < mp:
+                raise ValueError(
+                    f"model_parallel={mp} needs at least {mp} devices, "
+                    f"have {len(jax.devices())}")
+            n = min(len(jax.devices()) // mp, max(cfg.num_workers, 1))
             while cfg.num_workers % n:
                 n -= 1
             # slice-major DCN layout: real multi-slice topology is
@@ -80,7 +89,8 @@ class FedModel:
             # hardware must match the physical count); the flat
             # single-slice mesh is the default case of the same call
             mesh = make_multihost_client_mesh(
-                devices=jax.devices()[:n],
+                model_parallel=mp,
+                devices=jax.devices()[:n * mp],
                 num_slices=cfg.num_slices if cfg.num_slices > 1
                 else None)
         self.mesh = mesh

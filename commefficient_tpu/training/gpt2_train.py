@@ -148,12 +148,18 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
     logger = logger or TableLogger()
     spe = train_loader.steps_per_epoch
     epoch_download = epoch_upload = 0.0
-    batch_idx = 0
+    # on resume, num_epochs is the TOTAL budget: rounds already done
+    # (restored round_idx) count against it — same contract as
+    # cv_train.train (cv_train.py:136-140); without this the resumed
+    # run replays the whole budget and the lr schedule's final knot is
+    # exceeded (np.interp clamps lr to 0)
+    batch_idx = int(model.server.round_idx)
+    start_epoch = batch_idx // spe
     ckpt_path = os.path.join(cfg.checkpoint_path, "gpt2")
 
     if cfg.do_profile:
         jax.profiler.start_trace(os.path.join(log_dir or ".", "profile"))
-    for epoch in range(math.ceil(cfg.num_epochs)):
+    for epoch in range(start_epoch, math.ceil(cfg.num_epochs)):
         frac = (cfg.num_epochs - epoch
                 if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
         losses = []
@@ -228,10 +234,10 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 aborted = True
         if aborted:
             print(f"found nan/divergent loss {losses[-1]}, aborting")
-            if cfg.do_profile and epoch == 0:
+            if cfg.do_profile and epoch == start_epoch:
                 jax.profiler.stop_trace()
             return False
-        if cfg.do_profile and epoch == 0:
+        if cfg.do_profile and epoch == start_epoch:
             jax.profiler.stop_trace()
             print(f"profile trace written to "
                   f"{os.path.join(log_dir or '.', 'profile')}")

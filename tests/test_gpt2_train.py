@@ -112,3 +112,32 @@ def test_checkpoint_and_resume(tmp_path):
     assert os.path.exists(os.path.join(ck, "gpt2.npz"))
     assert run_main(tmp_path, "--mode", "uncompressed", "--resume",
                     "--checkpoint_path", ck, "--num_epochs", "2")
+
+
+def test_resume_counts_done_rounds_against_budget(tmp_path, capsys):
+    """num_epochs is a TOTAL budget on resume (cv_train contract,
+    cv_train.py:136-140): a resumed 1-epoch run may only top the round
+    count up to steps_per_epoch — not replay the whole epoch on top of
+    the restored state at a clamped lr of 0. (The first run can
+    under-fill the epoch: the sampler ends when fewer than num_workers
+    clients remain, the reference's own raggedness.)"""
+    import re
+
+    ck = str(tmp_path / "ck")
+    assert run_main(tmp_path, "--mode", "uncompressed",
+                    "--checkpoint", "--checkpoint_path", ck)
+    from commefficient_tpu.utils.checkpoint import load_checkpoint
+    rounds_before = int(load_checkpoint(
+        os.path.join(ck, "gpt2")).server.round_idx)
+    assert rounds_before > 0
+    spe = int(re.search(r"Steps per epoch (\d+)",
+                        capsys.readouterr().out).group(1))
+    assert run_main(tmp_path, "--mode", "uncompressed", "--resume",
+                    "--checkpoint", "--checkpoint_path", ck)
+    out = capsys.readouterr().out
+    assert "resumed from" in out
+    rounds_after = int(load_checkpoint(
+        os.path.join(ck, "gpt2")).server.round_idx)
+    assert rounds_before <= rounds_after <= spe, \
+        (f"resume must top up to the {spe}-round budget, not replay "
+         f"(before={rounds_before}, after={rounds_after})")

@@ -49,6 +49,22 @@ def test_multihost_mesh_validation():
         make_multihost_client_mesh(model_parallel=3)
 
 
+def test_fedmodel_default_mesh_honors_model_parallel():
+    """--model_parallel without a hand-built mesh must produce a
+    (clients, model) mesh, not silently consume every device as a
+    client shard."""
+    from commefficient_tpu.federated.api import FedModel
+
+    params = {"w": jnp.zeros(D)}
+    cfg = Config(mode="uncompressed", grad_size=D, weight_decay=0.0,
+                 num_workers=4, num_clients=8, local_momentum=0.0,
+                 virtual_momentum=0.0, error_type="none",
+                 microbatch_size=-1, model_parallel=2)
+    model = FedModel(None, loss_fn, cfg, params=params, num_clients=8)
+    assert model.mesh.axis_names == ("clients", "model")
+    assert dict(model.mesh.shape) == {"clients": 4, "model": 2}
+
+
 def test_sketch_round_matches_single_slice_mesh():
     """The same round on the flat clients mesh and on the emulated
     2-slice mesh (a genuinely permuted device placement — see
