@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Run every TPU measurement in sequence (single chip — scripts must not
+# overlap). Each script survives a tunnel outage on its own
+# (bench.run_orchestrated: TPU child under hard kill, CPU degrade), so
+# this is safe to run unattended; a degraded line is visible via
+# "platform": "cpu" / tpu_note in its JSON.
+#
+# Usage:  bash benchmarks/run_tpu_suite.sh [outdir]   (default: bench_out)
+set -u
+cd "$(dirname "$0")/.."
+out="${1:-bench_out}"
+mkdir -p "$out"
+
+probe() {
+    timeout 60 python -c "import jax; print(jax.devices()[0].platform)" \
+        2>/dev/null | tail -1
+}
+
+echo "tunnel probe: $(probe || echo down)"
+
+run() { # name, cmd...
+    local name="$1"; shift
+    echo "=== $name ==="
+    "$@" 2>&1 | tee "$out/$name.log" | tail -3
+}
+
+run headline   python bench.py
+run gpt2       python benchmarks/bench_gpt2.py
+run local_topk python benchmarks/bench_local_topk.py
+run profile    python benchmarks/profile_round.py
+
+# convergence.py runs in-process (no child harness) and would wedge on
+# a hung tunnel — only attempt the full-geometry run when the probe
+# answers, and bound it with a hard timeout either way
+if [ "$(probe)" = "tpu" ]; then
+    run convergence_full \
+        env CONV_FULL=1 timeout 3600 python benchmarks/convergence.py
+else
+    echo "=== convergence_full skipped (tunnel down) ==="
+fi
+
+echo "logs in $out/; JSON lines are each log's last '{' line"
