@@ -421,6 +421,22 @@ def _run_child(extra_env, timeout_s, script=None):
     return None
 
 
+def _tpu_probe(timeout_s) -> bool:
+    """Bring up jax.devices() in a hard-killed child and report
+    whether it reached a TPU. The tunnel hang is immune to SIGALRM
+    (it sits inside C++), so only a subprocess kill can bound the
+    wait; the probe doubles as the wake attempt for a tunnel that is
+    merely slow to rouse."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and r.stdout.strip().endswith("tpu")
+
+
 def run_orchestrated(small_env_key, script=None,
                      tpu_timeout=None, cpu_timeout=None):
     """The shared TPU-child-then-small-CPU-child sequence used by this
@@ -434,9 +450,16 @@ def run_orchestrated(small_env_key, script=None,
         cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
     out = None
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        out = _run_child({}, tpu_timeout, script=script)
-        if out is not None and out.get("platform") == "cpu":
-            log("TPU child self-degraded to CPU")
+        # probe first: a downed tunnel hangs the TPU child for the
+        # whole tpu_timeout (25 min) before the CPU fallback starts;
+        # the probe bounds that to INIT_TIMEOUT (5 min)
+        if _tpu_probe(INIT_TIMEOUT):
+            out = _run_child({}, tpu_timeout, script=script)
+            if out is not None and out.get("platform") == "cpu":
+                log("TPU child self-degraded to CPU")
+        else:
+            log(f"TPU probe got no chip within {INIT_TIMEOUT}s; "
+                f"skipping the TPU child")
     if out is None:
         log(f"falling back to a CPU child ({small_env_key} geometry)")
         out = _run_child({"JAX_PLATFORMS": "cpu", small_env_key: "1",
