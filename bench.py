@@ -485,6 +485,44 @@ def worker_entry(main_fn) -> int:
         return 3
 
 
+def _last_tpu_note() -> str:
+    """Cite the newest committed TPU artifact (by round number), with
+    its values read at runtime."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best, best_key = None, ()
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)(_builder)?\.json$",
+                      os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        # builder-recorded artifacts wrap the bench line in "parsed"
+        rec = rec.get("parsed", rec)
+        if (not isinstance(rec, dict) or rec.get("platform") != "tpu"
+                or rec.get("value") is None):
+            continue
+        key = (int(m.group(1)), 0 if m.group(2) else 1)
+        if key > best_key:
+            best, best_key = (os.path.basename(path), rec), key
+    if best is None:
+        return ("TPU tunnel was down for this run and no committed "
+                "TPU artifact was found")
+    name, rec = best
+    return (f"TPU tunnel was down for this run; last validated TPU "
+            f"measurement is committed in {name} "
+            f"({rec['value']:.1f} {rec.get('unit', 'ms/round')}, "
+            f"vs_baseline {rec.get('vs_baseline')})")
+
+
 def orchestrate() -> int:
     out = run_orchestrated("BENCH_SMALL")
     if out is None:
@@ -493,11 +531,10 @@ def orchestrate() -> int:
                "error": "all bench children failed or timed out"}
     if out.get("platform") != "tpu":
         # the axon tunnel flaps for hours at a time; a degraded run
-        # should still point the reader at the validated TPU numbers
-        out["tpu_note"] = ("TPU tunnel was down for this run; last "
-                           "validated TPU measurement is committed in "
-                           "BENCH_r03_builder.json (45.9 ms/round, "
-                           "vs_baseline 1.535)")
+        # should still point the reader at the newest validated TPU
+        # artifact — values read from the file so the note can never
+        # go stale against it
+        out["tpu_note"] = _last_tpu_note()
     print(json.dumps(out), flush=True)
     return 0
 

@@ -1,0 +1,48 @@
+"""Probe whether the axon TPU tunnel is alive, without wedging.
+
+`jax.devices()` on a dead tunnel can hang for hours inside C++ where
+SIGALRM is not deliverable, so the import happens in a spawned child
+that the parent hard-kills after a deadline.  Prints one line:
+``tpu <n>`` / ``cpu <n>`` / ``down``.
+
+Exit code 0 iff a TPU answered.
+"""
+
+import multiprocessing as mp
+import sys
+
+
+def _child(q):
+    try:
+        import jax
+
+        devs = jax.devices()
+        q.put((devs[0].platform, len(devs)))
+    except Exception as e:  # pragma: no cover - depends on env
+        q.put(("error", repr(e)))
+
+
+def probe(deadline_s: float = 90.0):
+    """Return (platform, count) or ('down', 0)."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child, args=(q,), daemon=True)
+    p.start()
+    p.join(deadline_s)
+    if p.is_alive():
+        p.kill()
+        p.join(5)
+        return ("down", 0)
+    try:
+        plat, n = q.get_nowait()
+    except Exception:
+        return ("down", 0)
+    if plat == "error":
+        return ("down", 0)
+    return (plat, n)
+
+
+if __name__ == "__main__":
+    plat, n = probe(float(sys.argv[1]) if len(sys.argv) > 1 else 90.0)
+    print(f"{plat} {n}" if plat != "down" else "down")
+    sys.exit(0 if plat == "tpu" else 1)

@@ -114,6 +114,16 @@ class Config:
     # make_multihost_client_mesh). 1 = flat single-slice mesh; real
     # slice topology is auto-detected either way
     num_slices: int = 1
+    # multi-HOST runtime (the reference's PS + worker process topology,
+    # fed_aggregator.py:143-164, as multi-controller SPMD): --multihost
+    # calls jax.distributed.initialize before any backend use. On TPU
+    # pods the coordinator/process grid is auto-detected; off-pod (CPU
+    # grids, tests) pass all three of coordinator_address /
+    # num_processes / process_id explicitly.
+    multihost: bool = False
+    coordinator_address: str = ""
+    num_processes: int = 0
+    process_id: int = -1
     # run client forward/backward in bfloat16 (f32 master weights and
     # f32 server/compression state; see client.make_flat_grad_fn) —
     # the MXU's fast path, an extension over the reference's fp32 CUDA
@@ -315,6 +325,18 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                    help="slice-major clients layout over DCN "
                         "(emulated when devices report no slice "
                         "topology; parallel/mesh.py)")
+    p.add_argument("--multihost", action="store_true",
+                   help="multi-controller run: jax.distributed."
+                        "initialize before any backend use (auto-"
+                        "detected grid on TPU pods; explicit "
+                        "--coordinator_address/--num_processes/"
+                        "--process_id elsewhere)")
+    p.add_argument("--coordinator_address", type=str, default="",
+                   help="host:port of process 0's coordination service")
+    p.add_argument("--num_processes", type=int, default=0,
+                   help="total controller processes (0 = auto-detect)")
+    p.add_argument("--process_id", type=int, default=-1,
+                   help="this process's index (-1 = auto-detect)")
     p.add_argument("--bf16", action="store_true", dest="do_bf16",
                    help="bfloat16 client fwd/bwd (f32 master weights)")
     p.add_argument("--remat", action="store_true", dest="do_remat",

@@ -24,11 +24,20 @@ class FedLoader:
 
     def __init__(self, dataset: FedDataset, num_workers: int,
                  local_batch_size: int, seed: int = 0,
-                 max_local_batch: int = -1):
+                 max_local_batch: int = -1,
+                 feed_slice: Optional[slice] = None):
+        """feed_slice: per-process batch feeding for multi-controller
+        runs (parallel/multihost.local_row_slice) — the sampler still
+        runs over the GLOBAL round (identical on every process, it is
+        pure seeded index math), but only the rows in `feed_slice` are
+        fetched/transformed/materialized. Yielded batches then carry
+        global client_ids with process-local data/mask rows, which is
+        exactly FedModel._call_train's multi-controller contract."""
         self.dataset = dataset
         self.sampler = FedSampler(dataset.data_per_client, num_workers,
                                   local_batch_size, seed=seed,
                                   max_local_batch=max_local_batch)
+        self.feed_slice = feed_slice
 
     @property
     def steps_per_epoch(self) -> int:
@@ -38,21 +47,31 @@ class FedLoader:
                                       np.ndarray]]:
         B = self.sampler.round_batch_size
         for r in self.sampler.epoch():
+            W = len(r.client_ids)
+            rows = (range(W) if self.feed_slice is None
+                    else range(*self.feed_slice.indices(W)))
+            if len(rows) == 0:
+                raise NotImplementedError(
+                    "this process owns no rows of the clients axis; "
+                    "zero-row feeding is not supported — use a mesh "
+                    "layout that gives every process client shards")
             per_client = []
-            for w, cid in enumerate(r.client_ids):
+            for w in rows:
                 n_valid = int(r.mask[w].sum())
                 got = self.dataset.get_client_batch(
-                    int(cid), r.idx_within[w, :n_valid])
+                    int(r.client_ids[w]), r.idx_within[w, :n_valid])
                 per_client.append((n_valid, got))
-            # allocate static [W, B, ...] buffers from the first fetch
+            # allocate static [W_local, B, ...] buffers from the first fetch
             protos = per_client[0][1]
             data = tuple(
-                np.zeros((len(r.client_ids), B) + p.shape[1:], p.dtype)
+                np.zeros((len(rows), B) + p.shape[1:], p.dtype)
                 for p in protos)
-            for w, (n_valid, got) in enumerate(per_client):
+            for i, (n_valid, got) in enumerate(per_client):
                 for buf, g in zip(data, got):
-                    buf[w, :n_valid] = g
-            yield r.client_ids, data, r.mask
+                    buf[i, :n_valid] = g
+            mask = (r.mask if self.feed_slice is None
+                    else r.mask[rows.start:rows.stop])
+            yield r.client_ids, data, mask
 
 
 class FedValLoader:
@@ -60,18 +79,26 @@ class FedValLoader:
     (reference _call_val sharding, fed_aggregator.py:337-348)."""
 
     def __init__(self, dataset: FedDataset, valid_batch_size: int,
-                 num_shards: int):
+                 num_shards: int, feed_slice: Optional[slice] = None):
+        """feed_slice: as FedLoader — only the shard rows this process
+        feeds are fetched in multi-controller runs."""
         self.dataset = dataset
         self.sampler = ValSampler(dataset.num_val_images, valid_batch_size,
                                   num_shards)
         self.vb = valid_batch_size
         self.num_shards = num_shards
+        self.feed_slice = feed_slice
 
     def batches(self):
         for r in self.sampler.batches():
-            flat_idx = r.idx_within.reshape(-1)
+            idx = r.idx_within
+            mask = r.mask
+            if self.feed_slice is not None:
+                idx = idx[self.feed_slice]
+                mask = mask[self.feed_slice]
+            flat_idx = idx.reshape(-1)
             got = self.dataset.get_val_batch(flat_idx)
             data = tuple(
-                g.reshape((self.num_shards, self.vb) + g.shape[1:])
+                g.reshape((idx.shape[0], self.vb) + g.shape[1:])
                 for g in got)
-            yield data, r.mask
+            yield data, mask

@@ -44,6 +44,7 @@ from commefficient_tpu.federated.accounting import (
     CommAccountant, pack_change_bits,
 )
 from commefficient_tpu.ops.flat import flatten_params
+from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 
 
@@ -87,12 +88,27 @@ class FedModel:
             # auto-detected; --num_slices > 1 emulates the grouping on
             # single-slice/CPU devices (and on real multi-slice
             # hardware must match the physical count); the flat
-            # single-slice mesh is the default case of the same call
-            mesh = make_multihost_client_mesh(
-                model_parallel=mp,
-                devices=jax.devices()[:n * mp],
-                num_slices=cfg.num_slices if cfg.num_slices > 1
-                else None)
+            # single-slice mesh is the default case of the same call.
+            # The device subset is chosen slice-balanced: a flat
+            # prefix of jax.devices() can land unevenly across slices
+            # (4+2 of 2x4) and the hybrid mesh build would fail; when
+            # no balanced pick exists, fall back to a flat mesh over
+            # the prefix.
+            from commefficient_tpu.parallel.mesh import (
+                make_client_mesh, make_client_model_mesh,
+                slice_balanced_prefix,
+            )
+            picked = slice_balanced_prefix(jax.devices(), n * mp)
+            if picked is not None:
+                mesh = make_multihost_client_mesh(
+                    model_parallel=mp, devices=picked,
+                    num_slices=cfg.num_slices if cfg.num_slices > 1
+                    else None)
+            elif mp == 1:
+                mesh = make_client_mesh(n)
+            else:
+                mesh = make_client_model_mesh(
+                    n, mp, devices=jax.devices()[:n * mp])
         self.mesh = mesh
         self.num_clients = cfg.resolved_num_clients(num_clients)
 
@@ -113,7 +129,7 @@ class FedModel:
         self._eval_batch = fround.make_eval_fn(
             self._loss_val, self.unravel, cfg, self.mesh)
 
-        self.server = fround.init_server_state(cfg, vec)
+        self.server = fround.init_server_state(cfg, vec, mesh=self.mesh)
         self.clients = fround.init_client_state(
             cfg, self.num_clients, vec, mesh=self.mesh)
 
@@ -123,12 +139,20 @@ class FedModel:
                           else int((grad_mask == 0).sum())))
         self._prev_change_words: Optional[np.ndarray] = None
         self._pack_bits = jax.jit(pack_change_bits)
-        self._key = jax.random.PRNGKey(cfg.seed)
+        from jax.sharding import PartitionSpec as P
+        self._P = P
+        # the PRNG key (like every jit operand) must be a GLOBAL array
+        # in a multi-controller run; globalize is a plain device_put in
+        # single-process ones
+        self._key = mh.globalize(self.mesh, P(),
+                                 jax.random.PRNGKey(cfg.seed))
         self._optimizer: Optional["FedOptimizer"] = None
         # per-parameter lr scale vector (Fixup param groups,
-        # reference fed_aggregator.py:411-427); None -> scalar lr
+        # reference fed_aggregator.py:411-427); None -> scalar lr.
+        # Held host-side: the product with the scheduler's lr is formed
+        # on host and globalized per call.
         self.lr_scale_vec = (None if lr_scale_vec is None
-                             else jnp.asarray(lr_scale_vec))
+                             else np.asarray(lr_scale_vec, np.float32))
 
     # -- reference API surface -------------------------------------------
     def train(self, training: bool):
@@ -153,6 +177,32 @@ class FedModel:
         fed_aggregator.py:372-376)."""
         return self.unravel(self.server.ps_weights)
 
+    def load_state(self, ckpt) -> int:
+        """Install a loaded `utils.checkpoint.Checkpoint` into this
+        model, globalizing every field onto this model's mesh — the
+        multi-controller-safe resume path (every process loads the same
+        file from shared storage, the reference's rank-0 rendezvous
+        inverted). Returns the checkpoint's scheduler step."""
+        P = self._P
+        s = ckpt.server
+        self.server = fround.ServerState(
+            mh.globalize(self.mesh, P(), s.ps_weights),
+            mh.globalize(self.mesh, P(), s.Vvelocity),
+            mh.globalize(self.mesh, P(), s.Verror),
+            mh.globalize(self.mesh, P(), s.round_idx))
+        if ckpt.clients is not None:
+            def place(field):
+                arr = np.asarray(field)
+                spec = P("clients", None) if arr.ndim == 2 else P()
+                return mh.globalize(self.mesh, spec, arr)
+            self.clients = fround.ClientState(
+                *[place(f) for f in ckpt.clients])
+        if ckpt.accountant_state:
+            self.accountant.load_state_dict(ckpt.accountant_state)
+        if ckpt.prev_change_words is not None:
+            self._prev_change_words = ckpt.prev_change_words
+        return ckpt.scheduler_step
+
     # -- internals --------------------------------------------------------
     def _lr(self):
         if self._optimizer is None:
@@ -167,15 +217,27 @@ class FedModel:
         return lr
 
     def _call_train(self, batch):
+        """batch = (client_ids, data, mask). `client_ids` is always the
+        GLOBAL [W] participant list (cheap; the sampler runs identically
+        on every process). In a multi-controller run, `data`/`mask`
+        carry ONLY this process's rows (FedLoader feed_slice →
+        multihost.local_row_slice): per-process batch feeding — no host
+        materializes the global batch."""
         client_ids, data, mask = batch
         prev_weights = self.server.ps_weights
 
+        P = self._P
+        lr = self._lr()
+        if isinstance(lr, np.ndarray):
+            lr = mh.globalize(self.mesh, P(), lr)
         self.server, self.clients, metrics = self._train_round(
             self.server, self.clients,
-            fround.RoundBatch(jnp.asarray(client_ids),
-                              tuple(jnp.asarray(d) for d in data),
-                              jnp.asarray(mask)),
-            self._lr(), self._key)
+            fround.RoundBatch(
+                mh.globalize(self.mesh, P(),
+                             np.asarray(client_ids, np.int32)),
+                tuple(mh.shard_rows(self.mesh, d) for d in data),
+                mh.shard_rows(self.mesh, mask)),
+            lr, self._key)
 
         # Communication accounting with ONE round of lag: this round's
         # change bitset is dispatched and its device->host copy started
@@ -206,19 +268,26 @@ class FedModel:
         returns zeros and skips the per-round popcount work, but the
         [N, D/32] bitset transfer and staleness bookkeeping still
         happen so later accounted rounds stay correct."""
-        lrs = jnp.asarray(lrs)
+        lrs = np.asarray(lrs, np.float32)
         if self.lr_scale_vec is not None:
             # per-parameter LR scaling — same routing _lr() applies on
             # the single-round path (incl. fedavg: the vector reaches
             # the clients' local steps)
             lrs = lrs[:, None] * self.lr_scale_vec[None, :]
+        P = self._P
+        # multi-controller feeding contract matches _call_train: ids
+        # global, data/mask rows process-local (leading [N] span axis
+        # unsharded)
         self.server, self.clients, metrics, bits = (
             self._train_round.train_rounds(
                 self.server, self.clients,
-                fround.RoundBatch(jnp.asarray(client_ids),
-                                  tuple(jnp.asarray(d) for d in data),
-                                  jnp.asarray(mask)),
-                lrs, self._key))
+                fround.RoundBatch(
+                    mh.globalize(self.mesh, P(),
+                                 np.asarray(client_ids, np.int32)),
+                    tuple(mh.shard_rows(self.mesh, d, leading_axes=1)
+                          for d in data),
+                    mh.shard_rows(self.mesh, mask, leading_axes=1)),
+                mh.globalize(self.mesh, P(), lrs), self._key))
 
         download = np.zeros(self.num_clients)
         upload = np.zeros(self.num_clients)
@@ -242,17 +311,21 @@ class FedModel:
                     ids_host[n], self._prev_change_words)
             self._prev_change_words = bits_host[n]
 
-        losses = np.asarray(metrics.losses)
-        mets = [np.asarray(m) for m in metrics.metrics]
+        losses = mh.gather_host(metrics.losses)
+        mets = [mh.gather_host(m) for m in metrics.metrics]
         return [losses, *mets, download, upload]
 
     def _call_val(self, batch):
+        """Multi-controller contract mirrors _call_train: `data`/`mask`
+        are this process's shard rows; results are allgathered so every
+        process returns the full per-shard metrics."""
         data, mask = batch
         loss, mets, count = self._eval_batch(
             self.server.ps_weights,
-            tuple(jnp.asarray(d) for d in data), jnp.asarray(mask))
-        return [np.asarray(loss), *[np.asarray(m) for m in mets],
-                np.asarray(count)]
+            tuple(mh.shard_rows(self.mesh, d) for d in data),
+            mh.shard_rows(self.mesh, mask))
+        return [mh.gather_host(loss), *[mh.gather_host(m) for m in mets],
+                mh.gather_host(count)]
 
 
 class FedOptimizer:

@@ -72,13 +72,26 @@ class RoundMetrics(NamedTuple):
     num_examples: jax.Array      # [num_workers]
 
 
-def init_server_state(cfg: Config, ps_weights: jax.Array) -> ServerState:
+def init_server_state(cfg: Config, ps_weights: jax.Array,
+                      mesh: Optional[Mesh] = None) -> ServerState:
+    """Server-state pytree. With a mesh, every field is built as a
+    GLOBAL replicated array — required in multi-controller runs, a
+    no-op placement in single-process ones (parallel/multihost.py)."""
     shape = cfg.state_shape
+    if mesh is None:
+        return ServerState(
+            ps_weights=ps_weights.astype(jnp.float32),
+            Vvelocity=jnp.zeros(shape, jnp.float32),
+            Verror=jnp.zeros(shape, jnp.float32),
+            round_idx=jnp.zeros((), jnp.int32),
+        )
+    from commefficient_tpu.parallel import multihost as mh
     return ServerState(
-        ps_weights=ps_weights.astype(jnp.float32),
-        Vvelocity=jnp.zeros(shape, jnp.float32),
-        Verror=jnp.zeros(shape, jnp.float32),
-        round_idx=jnp.zeros((), jnp.int32),
+        ps_weights=mh.globalize(
+            mesh, P(), jnp.asarray(ps_weights, jnp.float32)),
+        Vvelocity=mh.zeros(mesh, P(), shape),
+        Verror=mh.zeros(mesh, P(), shape),
+        round_idx=mh.globalize(mesh, P(), jnp.zeros((), jnp.int32)),
     )
 
 
@@ -95,26 +108,37 @@ def init_client_state(cfg: Config, num_clients: int,
     rows are inert: the round engine gathers/scatters participant rows
     by client id, and ids are always < the true num_clients."""
     D = cfg.grad_size
-    empty = jnp.zeros((0,), jnp.float32)
     n = mesh.shape["clients"] if mesh is not None else 1
     rows = -(-num_clients // n) * n
 
-    def alloc(shape):
-        arr = jnp.zeros(shape, jnp.float32)
-        if mesh is not None:
-            arr = jax.device_put(
-                arr, NamedSharding(mesh, P("clients", None)))
-        return arr
+    if mesh is not None:
+        from commefficient_tpu.parallel import multihost as mh
+
+        # even the zero-size placeholders must be global arrays in a
+        # multi-controller run (every jit operand needs a sharding on
+        # the global mesh)
+        empty = mh.zeros(mesh, P(), (0,))
+
+        def alloc(shape):
+            # global sharded allocation: shard-local zeros only — in a
+            # multi-controller run no host ever materializes the full
+            # [num_clients, D] block
+            return mh.zeros(mesh, P("clients", None), shape)
+    else:
+        empty = jnp.zeros((0,), jnp.float32)
+
+        def alloc(shape):
+            return jnp.zeros(shape, jnp.float32)
 
     errors = alloc((rows, D)) if cfg.error_type == "local" else empty
     velocities = (alloc((rows, D)) if cfg.local_momentum > 0
                   else empty)
     if cfg.do_topk_down:
         assert ps_weights is not None
-        weights = jnp.broadcast_to(ps_weights, (rows, D)).copy()
         if mesh is not None:
-            weights = jax.device_put(
-                weights, NamedSharding(mesh, P("clients", None)))
+            weights = mh.tile_rows(mesh, ps_weights, rows)
+        else:
+            weights = jnp.broadcast_to(ps_weights, (rows, D)).copy()
     else:
         weights = empty
     return ClientState(errors, velocities, weights)

@@ -39,6 +39,33 @@ def make_client_model_mesh(num_client_shards: int, model_parallel: int,
     return Mesh(arr, axis_names=("clients", "model"))
 
 
+def slice_balanced_prefix(devices: Sequence[jax.Device],
+                          count: int) -> Optional[list]:
+    """Pick `count` devices spread EQUALLY across physical slices
+    (slice-major order), or None when that isn't possible.
+
+    A flat prefix of jax.devices() can span slices unevenly when a run
+    uses fewer devices than exist (e.g. 2 slices x 4 devices, count=6
+    -> 4+2), and the hybrid mesh construction requires equal per-slice
+    counts. Callers fall back to a flat mesh on None."""
+    devices = list(devices)
+    slices: dict = {}
+    for d in devices:
+        slices.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    n_sl = len(slices)
+    if n_sl <= 1:
+        return devices[:count] if count <= len(devices) else None
+    per = count // n_sl
+    if per * n_sl != count:
+        return None
+    if any(len(g) < per for g in slices.values()):
+        return None
+    out = []
+    for k in sorted(slices):
+        out.extend(slices[k][:per])
+    return out
+
+
 def make_multihost_client_mesh(model_parallel: int = 1,
                                devices: Optional[Sequence[jax.Device]] = None,
                                num_slices: Optional[int] = None) -> Mesh:
@@ -61,6 +88,15 @@ def make_multihost_client_mesh(model_parallel: int = 1,
     is regrouped slice-major — a genuine permutation of the flat device
     order, so tests exercise a non-identity placement (the round's
     results must be placement-invariant).
+
+    The emulation is for CORRECTNESS testing only: combined with
+    model_parallel > 1 on real single-slice hardware it pairs
+    non-adjacent physical devices on the model axis (e.g. (0,2),(4,6)),
+    putting TP collectives on slower ICI paths than the 'model axis
+    innermost = fastest ICI' contract this module otherwise keeps. Do
+    not use --num_slices emulation with model_parallel for performance
+    runs on real hardware — on real multi-slice topology the emulation
+    is bypassed (the physical layout wins, above).
     """
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)

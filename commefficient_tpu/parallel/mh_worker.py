@@ -1,0 +1,238 @@
+"""Spawnable multi-controller worker: one fixed, deterministic FedModel
+scenario, runnable either single-process or as one process of an
+N-process grid (coordination service + Gloo CPU collectives).
+
+This is the executable proof of the multi-host runtime (the reference's
+process topology is PS + N workers rendezvousing over
+torch.distributed, CommEfficient/fed_aggregator.py:143-164; here it is
+N equal controllers of one SPMD program): the SAME global program —
+sketch rounds through FedModel's per-round path, a scanned multi-round
+span, communication accounting, and an eval pass — must produce the
+same results whether one process feeds all 8 mesh devices or two
+processes each feed their 4, with per-process batch feeding
+(multihost.local_row_slice → make_array_from_process_local_data).
+
+Used by tests/test_multihost.py and __graft_entry__.dryrun_multichip;
+each spawns the interpreter with::
+
+    python -m commefficient_tpu.parallel.mh_worker --out r0.npz \
+        --process_id 0 --num_processes 2 --port 29517   # and pid 1
+    python -m commefficient_tpu.parallel.mh_worker --out ref.npz  # single
+
+Import discipline: jax is imported inside main() AFTER environment
+setup so ``jax.distributed.initialize`` precedes any backend touch.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+# scenario constants — identical in every process and in the
+# single-process reference run
+W, B, N_CLIENTS, ROUNDS, SPAN = 8, 2, 16, 3, 2
+MESH_DEVICES = 8
+
+
+def _scenario_batches():
+    """Deterministic per-round global batches [ROUNDS + SPAN]."""
+    rs = np.random.RandomState(0)
+    out = []
+    for t in range(ROUNDS + SPAN):
+        x = rs.randn(W, B, 16, 16, 3).astype(np.float32)
+        y = rs.randint(0, 10, (W, B)).astype(np.int32)
+        ids = ((np.arange(W) * 2 + t) % N_CLIENTS).astype(np.int32)
+        out.append((ids, x, y, np.ones((W, B), np.float32)))
+    return out
+
+
+def run_scenario(out_path: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.federated.api import FedModel, FedOptimizer
+    from commefficient_tpu.models import ResNet9
+    from commefficient_tpu.parallel import multihost as mh
+    from commefficient_tpu.parallel.mesh import make_client_mesh
+
+    model = ResNet9(
+        num_classes=10,
+        channels={"prep": 4, "layer1": 8, "layer2": 8, "layer3": 8})
+
+    def loss_fn(params, batch, mask):
+        xb, yb = batch
+        logits = model.apply(params, xb)
+        logp = jax.nn.log_softmax(logits)
+        per_ex = -jnp.take_along_axis(logp, yb[:, None], axis=1)[:, 0]
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per_ex * mask).sum() / denom
+        acc = ((logits.argmax(-1) == yb) * mask).sum() / denom
+        return loss, (acc,)
+
+    mesh = make_client_mesh(MESH_DEVICES)
+    # do_topk_down gives the scenario per-client PERSISTENT state (the
+    # stale-weights rows), so the cross-process sharded gather/scatter
+    # path and the chunked checkpoint gather are both exercised
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 local_momentum=0.0, k=16, num_rows=3, num_cols=512,
+                 num_blocks=1, weight_decay=5e-4, microbatch_size=-1,
+                 num_workers=W, num_clients=N_CLIENTS, seed=0,
+                 do_topk_down=True)
+    fed = FedModel(model, loss_fn, cfg, mesh=mesh,
+                   init_batch=(np.zeros((B, 16, 16, 3), np.float32),))
+    opt = FedOptimizer(fed)
+    opt.param_groups[0]["lr"] = 0.1
+
+    sl = mh.local_row_slice(mesh, W)
+    batches = _scenario_batches()
+
+    losses, downloads, uploads = [], None, None
+    for ids, x, y, mask in batches[:ROUNDS]:
+        out = fed((ids, (x[sl], y[sl]), mask[sl]))
+        losses.append(mh.gather_host(out[0]))
+        downloads, uploads = out[-2], out[-1]
+
+    # scanned multi-round span through the same multihost feeding path
+    span = batches[ROUNDS:]
+    ids_s = np.stack([b[0] for b in span])
+    x_s = np.stack([b[1][sl] for b in span])
+    y_s = np.stack([b[2][sl] for b in span])
+    m_s = np.stack([b[3][sl] for b in span])
+    out = fed.run_rounds(ids_s, (x_s, y_s), m_s,
+                         np.full((SPAN,), 0.1, np.float32))
+    span_losses, downloads, uploads = out[0], out[-2], out[-1]
+
+    # eval pass (forward-only shard_map path)
+    rs = np.random.RandomState(99)
+    ex = rs.randn(MESH_DEVICES, B, 16, 16, 3).astype(np.float32)
+    ey = rs.randint(0, 10, (MESH_DEVICES, B)).astype(np.int32)
+    emask = np.ones((MESH_DEVICES, B), np.float32)
+    esl = mh.local_row_slice(mesh, MESH_DEVICES)
+    fed.train(False)
+    eval_out = fed(((ex[esl], ey[esl]), emask[esl]))
+
+    # checkpoint round-trip: collective chunked gather of the sharded
+    # per-client state; only the coordinator writes/reads the file
+    from commefficient_tpu.utils.checkpoint import (
+        load_checkpoint, save_checkpoint,
+    )
+    ckpt_path = out_path + ".ckpt"
+    save_checkpoint(ckpt_path, fed.server, fed.clients,
+                    scheduler_step=7, accountant=fed.accountant,
+                    prev_change_words=fed._prev_change_words,
+                    chunk_rows=4)
+    if mh.is_coordinator():
+        ck = load_checkpoint(ckpt_path)
+        assert ck.scheduler_step == 7
+        np.savez(out_path,
+                 ps_weights=np.asarray(fed.ps_weights),
+                 losses=np.stack(losses),
+                 span_losses=np.asarray(span_losses),
+                 eval_loss=np.asarray(eval_out[0]),
+                 download=np.asarray(downloads),
+                 upload=np.asarray(uploads),
+                 ckpt_ps_weights=np.asarray(ck.server.ps_weights),
+                 ckpt_client_weights=np.asarray(ck.clients.weights),
+                 process_count=mh.process_count())
+    mh.sync_processes("scenario-done")
+    print(f"mh_worker pid={mh.process_index()}/{mh.process_count()} ok",
+          flush=True)
+
+
+# keys every scenario artifact carries; the grid runner compares all
+# of them against the single-process reference
+RESULT_KEYS = ("ps_weights", "losses", "span_losses", "eval_loss",
+               "download", "upload", "ckpt_ps_weights",
+               "ckpt_client_weights")
+
+
+def run_grid_vs_reference(out_dir: str, timeout: float = 600.0,
+                          rtol: float = 1e-5, atol: float = 1e-6) -> dict:
+    """Spawn the scenario as a 2-process × 4-device grid AND as one
+    8-device process, then assert every RESULT_KEYS entry matches.
+    Returns the grid's loaded arrays. Shared by
+    tests/test_multihost.py and __graft_entry__.dryrun_multichip —
+    one harness, two callers."""
+    import socket
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ref = os.path.join(out_dir, "ref.npz")
+    two = os.path.join(out_dir, "two.npz")
+
+    def spawn(args):
+        return subprocess.Popen(
+            [sys.executable, "-m", "commefficient_tpu.parallel.mh_worker",
+             *args],
+            cwd=repo, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+
+    procs = [
+        spawn(["--out", two, "--process_id", "0",
+               "--num_processes", "2", "--port", str(port)]),
+        spawn(["--out", two + ".ignored", "--process_id", "1",
+               "--num_processes", "2", "--port", str(port)]),
+        spawn(["--out", ref]),
+    ]
+    logs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+
+    a, b = np.load(ref), np.load(two)
+    assert int(b["process_count"]) == 2
+    for key in RESULT_KEYS:
+        np.testing.assert_allclose(a[key], b[key], rtol=rtol, atol=atol,
+                                   err_msg=key)
+    return {k: b[k] for k in b.files}
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--process_id", type=int, default=None)
+    ap.add_argument("--num_processes", type=int, default=None)
+    ap.add_argument("--port", type=int, default=29517)
+    ap.add_argument("--local_devices", type=int, default=None,
+                    help="virtual CPU devices in THIS process (default: "
+                         "mesh size / num_processes, or mesh size when "
+                         "single-process)")
+    args = ap.parse_args(argv)
+
+    multi = args.num_processes is not None and args.num_processes > 1
+    n_local = args.local_devices or (
+        MESH_DEVICES // args.num_processes if multi else MESH_DEVICES)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_local}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    # the interpreter may have pre-imported jax and registered the TPU
+    # tunnel plugin (tests/conftest.py documents the freeze); config
+    # wins over the captured env
+    jax.config.update("jax_platforms", "cpu")
+
+    if multi:
+        from commefficient_tpu.parallel import multihost as mh
+        mh.initialize(coordinator_address=f"127.0.0.1:{args.port}",
+                      num_processes=args.num_processes,
+                      process_id=args.process_id)
+
+    run_scenario(args.out)
+
+
+if __name__ == "__main__":
+    main()

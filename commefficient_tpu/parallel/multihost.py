@@ -1,0 +1,249 @@
+"""Multi-host (multi-process) runtime support.
+
+The reference's runtime is a multi-process topology on one box: a PS
+process plus N worker processes rendezvousing over
+``torch.distributed`` (reference: CommEfficient/fed_aggregator.py:143-164).
+Its TPU-native equivalent at the BASELINE v4-32 scale is a multi-HOST
+JAX job: one Python process per host, each addressing its local chips,
+all running the SAME program over one global mesh (multi-controller
+SPMD). This module is everything the rest of the framework needs to
+run that way:
+
+  * :func:`initialize` — ``jax.distributed.initialize`` with the
+    session's frozen-platform workaround (the interpreter may have
+    pre-registered the TPU tunnel plugin; see tests/conftest.py).
+  * :func:`globalize` — lift a host value every process holds
+    identically (PS weights, client ids, LR vectors, PRNG keys) into a
+    global array with an explicit sharding on the global mesh.
+  * :func:`shard_rows` — per-process batch feeding: each process
+    passes ONLY the batch rows its addressable devices own
+    (``jax.make_array_from_process_local_data``), so no host ever
+    materializes the global batch — the fix for the round-3 gap where
+    FedModel ``jnp.asarray``-ed host-global batches.
+  * :func:`local_row_slice` — which rows of a ``[num_workers, ...]``
+    round batch this process must feed (FedLoader materializes only
+    these).
+  * :func:`gather_host` — materialize a possibly cross-process-sharded
+    metric on every host (``process_allgather``); the identity in
+    single-process runs.
+  * :func:`is_coordinator` — process-0 guard for logging, checkpoint
+    writes, and accounting output.
+
+Design note: everything degrades to a no-op in single-process runs —
+``process_count() == 1`` keeps the exact round-3 code paths, so the
+single-chip bench and the 8-device CPU test mesh are untouched.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None) -> None:
+    """``jax.distributed.initialize``, before any backend use.
+
+    On TPU pods every argument is auto-detected from the TPU
+    environment, so a bare ``initialize()`` suffices; elsewhere
+    (CPU/GPU grids, the emulated two-process CPU mode the tests use)
+    pass the coordinator and process grid explicitly."""
+    global _initialized
+    if _initialized:
+        # idempotent: drivers and libraries may both ask for the
+        # runtime; the second caller gets the existing one
+        return
+    kw = {}
+    if coordinator_address:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    if local_device_ids is not None:
+        kw["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kw)
+    _initialized = True
+
+
+def initialize_from_config(cfg) -> None:
+    """Driver entry: honor --multihost/--coordinator_address/
+    --num_processes/--process_id (config.py flags)."""
+    initialize(
+        coordinator_address=cfg.coordinator_address or None,
+        num_processes=cfg.num_processes if cfg.num_processes > 0 else None,
+        process_id=cfg.process_id if cfg.process_id >= 0 else None)
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns logging/checkpointing/accounting
+    output (the reference's rank-0 PS process)."""
+    return jax.process_index() == 0
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+# ---------------------------------------------------------------------------
+# array construction
+
+
+def globalize(mesh: Mesh, spec: P, value) -> jax.Array:
+    """Lift a host value that EVERY process holds identically into a
+    global array with sharding ``NamedSharding(mesh, spec)``.
+
+    Single-process: plain ``jax.device_put`` with the sharding (so
+    state still lands sharded on the local mesh). Multi-process: each
+    process contributes the shards its devices own via
+    ``make_array_from_callback`` indexing into the (identical) host
+    value — correct for any device→process layout."""
+    sharding = NamedSharding(mesh, spec)
+    if not is_multihost():
+        return jax.device_put(jnp.asarray(value), sharding)
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def shard_rows(mesh: Mesh, local_rows, leading_axes: int = 0) -> jax.Array:
+    """Per-process batch feeding: build the global ``[W, ...]`` round
+    array from THIS process's rows only.
+
+    ``local_rows``: the rows owned by this process's devices, in mesh
+    order — shape ``[W_local, ...]`` (``leading_axes=0``) or with
+    ``leading_axes`` unsharded leading dims before the clients axis
+    (the scanned multi-round span's ``[N, W_local, ...]``).
+
+    Single-process: device_put of the (already global) rows."""
+    spec = P(*([None] * leading_axes), "clients",
+             *([None] * (np.ndim(local_rows) - leading_axes - 1)))
+    sharding = NamedSharding(mesh, spec)
+    if not is_multihost():
+        return jax.device_put(jnp.asarray(local_rows), sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_rows))
+
+
+def local_row_slice(mesh: Mesh, num_rows: int) -> slice:
+    """The contiguous block of a ``[num_rows, ...]`` clients-sharded
+    array that this process feeds (and therefore the only rows its
+    FedLoader must materialize).
+
+    Requires this process's devices to hold a contiguous block of the
+    mesh's ``clients`` axis — true for the standard process-major
+    device order of ``jax.devices()``; raises otherwise rather than
+    feeding rows to the wrong process."""
+    axis_devices = _clients_axis_devices(mesh)
+    n_shards = len(axis_devices)
+    if num_rows % n_shards:
+        raise ValueError(f"num_rows={num_rows} not divisible by the "
+                         f"{n_shards}-way clients axis")
+    rows_per_shard = num_rows // n_shards
+    me = jax.process_index()
+    mine = [i for i, d in enumerate(axis_devices) if d.process_index == me]
+    if not mine:
+        return slice(0, 0)
+    lo, hi = min(mine), max(mine)
+    if mine != list(range(lo, hi + 1)):
+        raise ValueError(
+            "this process's devices are not a contiguous block of the "
+            "clients axis; feed globally with globalize() instead")
+    return slice(lo * rows_per_shard, (hi + 1) * rows_per_shard)
+
+
+def _clients_axis_devices(mesh: Mesh):
+    """Mesh devices along the clients axis (first model-column when a
+    model axis exists: the clients coordinate determines the row
+    block; every model-column replica of a row must then live in the
+    same process for local feeding, which `local_row_slice` verifies
+    via contiguity of the flattened list)."""
+    axes = list(mesh.axis_names)
+    arr = mesh.devices
+    if axes == ["clients"]:
+        return list(arr.reshape(-1))
+    # move the clients axis first, take the first element of the rest
+    k = axes.index("clients")
+    arr = np.moveaxis(arr, k, 0)
+    return list(arr.reshape(arr.shape[0], -1)[:, 0])
+
+
+def zeros(mesh: Mesh, spec: P, shape: Tuple[int, ...],
+          dtype=jnp.float32) -> jax.Array:
+    """Zero-initialized global array. Multi-process path allocates only
+    this process's shards (per-shard callback) — the per-client state
+    arrays are the framework's memory hazard (SURVEY.md §7.0) and must
+    never materialize host-globally."""
+    sharding = NamedSharding(mesh, spec)
+    if not is_multihost():
+        return jax.device_put(jnp.zeros(shape, dtype), sharding)
+    return jax.make_array_from_callback(
+        tuple(shape), sharding,
+        lambda idx: np.zeros(_shard_shape(idx, shape), dtype))
+
+
+def tile_rows(mesh: Mesh, vec, rows: int) -> jax.Array:
+    """``[rows, D]`` global array whose every row is ``vec``, sharded
+    ``P('clients', None)`` — the per-client stale-weights state of the
+    download-top-k path. Shard-local materialization only."""
+    host = np.asarray(vec)
+    shape = (rows, host.shape[0])
+    sharding = NamedSharding(mesh, P("clients", None))
+    if not is_multihost():
+        return jax.device_put(
+            jnp.broadcast_to(jnp.asarray(host), shape), sharding)
+
+    def cb(idx):
+        return np.broadcast_to(host[idx[1]],
+                               _shard_shape(idx, shape)).copy()
+
+    return jax.make_array_from_callback(shape, sharding, cb)
+
+
+def _shard_shape(idx: Tuple[slice, ...], shape: Tuple[int, ...]):
+    return tuple(len(range(*s.indices(n))) for s, n in zip(idx, shape))
+
+
+# ---------------------------------------------------------------------------
+# result materialization
+
+
+def gather_host(x) -> np.ndarray:
+    """Materialize a (possibly cross-process-sharded) device array on
+    every host. Identity (``np.asarray``) when the array is fully
+    addressable; ``process_allgather`` otherwise."""
+    if isinstance(x, np.ndarray) or np.isscalar(x):
+        return np.asarray(x)
+    if getattr(x, "is_fully_addressable", True) or _fully_replicated(x):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(x, tiled=True)
+
+
+def _fully_replicated(x) -> bool:
+    try:
+        return bool(x.is_fully_replicated)
+    except AttributeError:
+        return False
+
+
+def sync_processes(name: str = "barrier") -> None:
+    """Cross-process barrier (checkpoint write ordering)."""
+    if is_multihost():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)

@@ -36,6 +36,7 @@ from commefficient_tpu.data import (
     FedValLoader, transforms,
 )
 from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.training.scanloop import run_scanned_rounds
 from commefficient_tpu.utils.checkpoint import (
@@ -142,7 +143,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
     total_up = np.zeros(model.num_clients)
 
     writer = None
-    if cfg.use_tensorboard:
+    if cfg.use_tensorboard and mh.is_coordinator():
         writer = _try_tensorboard(log_dir)
 
     profiling = False
@@ -160,6 +161,20 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
         down = np.zeros(model.num_clients)
         up = np.zeros(model.num_clients)
 
+        # EMNIST prints one line per STEP (reference cv_train.py:233-237)
+        per_step_log = (cfg.dataset_name == "EMNIST"
+                        and mh.is_coordinator())
+        step_t0 = [_now()]
+        # scan mode has no per-round boundaries — rounds of a span all
+        # emit at flush — so Time is the span-amortized per-round value
+        # (set by on_flush); the unscanned path measures each step
+        amortized = [0.0]
+
+        def step_line(lr, elapsed):
+            print("LR: {:0.5f}, Loss: {:0.5f}, Acc: {:0.5f}, "
+                  "Time: {:0.2f}".format(float(lr), losses[-1], accs[-1],
+                                         elapsed))
+
         if cfg.scan_rounds:
             # scanned device programs, flushed every --scan_span rounds
             # to bound the staged [N, W, B, ...] arrays (0 = whole
@@ -174,12 +189,18 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                         return
                     lr_scheduler.step()
                     taken += 1
-                    yield (None, client_ids, data, mask,
-                           opt.param_groups[0]["lr"])
+                    lr = opt.param_groups[0]["lr"]
+                    yield (lr, client_ids, data, mask, lr)
 
-            def scan_emit(_tag, loss_w, acc_w):
+            def on_flush(n_rounds):
+                amortized[0] = (_now() - step_t0[0]) / max(n_rounds, 1)
+                step_t0[0] = _now()
+
+            def scan_emit(lr, loss_w, acc_w):
                 losses.append(float(np.mean(loss_w)))
                 accs.append(float(np.mean(acc_w)))
+                if per_step_log:
+                    step_line(lr, amortized[0])
                 return True  # NaN abort handled by the epoch-mean check
 
             def on_comm(d, u):
@@ -190,7 +211,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             run_scanned_rounds(
                 model, stream(),
                 cfg.scan_span if cfg.scan_span > 0 else epoch_rounds,
-                scan_emit, on_comm)
+                scan_emit, on_comm, on_flush=on_flush)
             rounds_done += taken
         else:
             # metrics materialize with a ONE-ROUND lag: float()ing the
@@ -199,8 +220,14 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             # t-1's values are already computed, so float() is free.
             # NaN abort latency grows by exactly one round.
             def emit(p) -> bool:
-                losses.append(float(np.mean(p[0])))
-                accs.append(float(np.mean(p[1])))
+                # gather_host: per-client metrics are cross-process
+                # sharded in multi-controller runs (np.asarray in
+                # single-process ones)
+                losses.append(float(np.mean(mh.gather_host(p[0]))))
+                accs.append(float(np.mean(mh.gather_host(p[1]))))
+                if per_step_log:
+                    step_line(p[2], _now() - step_t0[0])
+                    step_t0[0] = _now()
                 return not np.isnan(losses[-1])
 
             pending = None
@@ -215,7 +242,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 if pending is not None and not emit(pending):
                     pending = None
                     break
-                pending = (loss, acc)
+                pending = (loss, acc, opt.param_groups[0]["lr"])
                 rounds_done += 1
             if pending is not None:
                 emit(pending)
@@ -232,9 +259,11 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
         mean_loss = float(np.mean(losses)) if losses else float("nan")
         mean_acc = float(np.mean(accs)) if accs else float("nan")
 
-        # NaN abort (reference cv_train.py:110-112,222-224)
+        # NaN abort (reference cv_train.py:110-112,222-224); every
+        # controller computes the same mean, so all abort together
         if np.isnan(mean_loss) or mean_loss > cfg.nan_threshold:
-            print(f"found nan/divergent loss {mean_loss}, aborting")
+            if mh.is_coordinator():
+                print(f"found nan/divergent loss {mean_loss}, aborting")
             return False
 
         val_loss, val_acc = run_eval(model, val_loader)
@@ -266,9 +295,15 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                             scheduler_step=lr_scheduler.step_count,
                             accountant=model.accountant,
                             prev_change_words=model._prev_change_words)
-            print(f"checkpointed to {path}")
+            if mh.is_coordinator():
+                print(f"checkpointed to {path}")
 
     return True
+
+
+def _now() -> float:
+    import time
+    return time.time()
 
 
 def _try_tensorboard(log_dir):
@@ -289,7 +324,11 @@ def _ckpt_path(cfg: Config) -> str:
 def main(argv=None) -> bool:
     enable_persistent_compilation_cache()
     cfg = parse_args(argv=argv)
-    print(cfg)
+    if cfg.multihost:
+        # must precede every backend touch (jax.device_count below)
+        mh.initialize_from_config(cfg)
+    if mh.is_coordinator():
+        print(cfg)
     timer = Timer()
     np.random.seed(cfg.seed)
 
@@ -340,7 +379,8 @@ def main(argv=None) -> bool:
     # per-parameter scale vector (reference cv_train.py:366-376 builds
     # param groups with lr 0.1/0.1/1)
     if cfg.model.startswith("Fixup"):
-        print("using fixup learning rates")
+        if mh.is_coordinator():
+            print("using fixup learning rates")
         lr_scale_vec = _fixup_lr_scales(params)
 
     compute_loss = make_compute_loss(module)
@@ -349,18 +389,20 @@ def main(argv=None) -> bool:
                      lr_scale_vec=lr_scale_vec)
     opt = FedOptimizer(model)
 
+    if mh.is_multihost():
+        # per-process batch feeding: this controller materializes only
+        # the round-batch rows its devices own
+        train_loader.feed_slice = mh.local_row_slice(
+            model.mesh, cfg.num_workers)
+        val_loader.feed_slice = mh.local_row_slice(
+            model.mesh, val_loader.num_shards)
+
     if cfg.resume and os.path.exists(_ckpt_path(cfg) + ".npz"):
         ckpt = load_checkpoint(_ckpt_path(cfg))
-        model.server = ckpt.server
-        sched_step = ckpt.scheduler_step
-        if ckpt.clients is not None:
-            model.clients = ckpt.clients
-        if ckpt.accountant_state:
-            model.accountant.load_state_dict(ckpt.accountant_state)
-        if ckpt.prev_change_words is not None:
-            model._prev_change_words = ckpt.prev_change_words
-        print(f"resumed from {_ckpt_path(cfg)} at round "
-              f"{int(ckpt.server.round_idx)}")
+        sched_step = model.load_state(ckpt)
+        if mh.is_coordinator():
+            print(f"resumed from {_ckpt_path(cfg)} at round "
+                  f"{int(ckpt.server.round_idx)}")
     else:
         sched_step = 0
 
@@ -373,19 +415,25 @@ def main(argv=None) -> bool:
     lr_scheduler = LambdaLR(opt, lr_lambda=lambda step: schedule(step / spe))
     lr_scheduler.load_state_dict({"step_count": sched_step})
 
-    log_dir = make_logdir(cfg)
-    print(f"Finished initializing in {timer():.2f} seconds")
+    coord = mh.is_coordinator()
+    # only the coordinator creates a run dir
+    log_dir = make_logdir(cfg) if coord else ""
+    if coord:
+        print(f"Finished initializing in {timer():.2f} seconds")
 
     ok = train(model, opt, lr_scheduler, train_loader, val_loader, cfg,
-               loggers=(TableLogger(),), timer=timer, log_dir=log_dir)
+               loggers=(TableLogger(),) if coord else (), timer=timer,
+               log_dir=log_dir)
     model.finalize()
 
     if cfg.do_checkpoint:
+        # collective (gathers sharded client state); coordinator writes
         path = save_checkpoint(_ckpt_path(cfg), model.server, model.clients,
                                scheduler_step=lr_scheduler.step_count,
                                accountant=model.accountant,
                                prev_change_words=model._prev_change_words)
-        print(f"saved checkpoint to {path}")
+        if coord:
+            print(f"saved checkpoint to {path}")
     return ok
 
 

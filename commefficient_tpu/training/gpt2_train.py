@@ -38,12 +38,15 @@ from commefficient_tpu.models.gpt2 import (
     resize_position_embeddings, resize_token_embeddings, save_pretrained,
     try_load_pretrained,
 )
+from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 from commefficient_tpu.parallel.tp import tp_loss
 from commefficient_tpu.training.scanloop import run_scanned_rounds
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
-from commefficient_tpu.utils.logging import TableLogger, Timer, make_logdir
+from commefficient_tpu.utils.logging import (
+    NullLogger, TableLogger, Timer, make_logdir,
+)
 from commefficient_tpu.utils.schedules import LambdaLR, PiecewiseLinear
 
 
@@ -155,6 +158,12 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
     # exceeded (np.interp clamps lr to 0)
     batch_idx = int(model.server.round_idx)
     start_epoch = batch_idx // spe
+    # mid-epoch resume: fast-forward the first resumed epoch's loader
+    # stream past the rounds already trained, so the epoch's early
+    # batches aren't re-trained while batch_idx continues mid-epoch
+    # (data coverage matches an uninterrupted run up to the sampler's
+    # fresh permutation; LR schedule and budget were already correct)
+    skip_rounds = batch_idx % spe
     ckpt_path = os.path.join(cfg.checkpoint_path, "gpt2")
 
     if cfg.do_profile:
@@ -170,6 +179,10 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
         # every round (PERF.md). NaN abort latency grows by one round.
         def emit(p) -> bool:
             bidx, lr_v, l_, lm_, mc_ = p
+            # gather_host: metrics are cross-process sharded in
+            # multi-controller runs (np.asarray otherwise)
+            l_, lm_, mc_ = (mh.gather_host(l_), mh.gather_host(lm_),
+                            mh.gather_host(mc_))
             losses.append(float(np.mean(l_)))
             logger.append({
                 "batch_idx": bidx,
@@ -185,12 +198,17 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
         pending = None
         aborted = False
+        epoch_stream = train_loader.epoch()
+        if skip_rounds:
+            for _ in range(skip_rounds):
+                next(epoch_stream, None)
+            skip_rounds = 0
         if cfg.scan_rounds:
             # scanned device programs, flushed every --scan_span rounds
             # (symmetric with cv_train; bounds the staged token arrays)
             def stream():
                 nonlocal batch_idx
-                for client_ids, data, mask in train_loader.epoch():
+                for client_ids, data, mask in epoch_stream:
                     if batch_idx - epoch * spe >= spe * frac:
                         return
                     lr_scheduler.step()
@@ -212,7 +230,7 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                     (tag[0], tag[1], l_, lm_, mc_)),
                 on_comm)
         else:
-            for client_ids, data, mask in train_loader.epoch():
+            for client_ids, data, mask in epoch_stream:
                 if batch_idx - epoch * spe >= spe * frac:
                     break
                 lr_scheduler.step()
@@ -233,7 +251,8 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
             if pending is not None and not emit(pending):
                 aborted = True
         if aborted:
-            print(f"found nan/divergent loss {losses[-1]}, aborting")
+            if mh.is_coordinator():
+                print(f"found nan/divergent loss {losses[-1]}, aborting")
             if cfg.do_profile and epoch == start_epoch:
                 jax.profiler.stop_trace()
             return False
@@ -249,15 +268,17 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                             scheduler_step=lr_scheduler.step_count,
                             accountant=model.accountant,
                             prev_change_words=model._prev_change_words)
-            print(f"checkpointed to {ckpt_path}")
+            if mh.is_coordinator():
+                print(f"checkpointed to {ckpt_path}")
 
     n_clients = model.num_clients
-    print(f"Total Download (MiB): {epoch_download:0.2f} (only epoch 1)")
-    print(f"Total Upload (MiB): {epoch_upload:0.2f} (only epoch 1)")
-    print(f"Avg Download Per Client: {epoch_download / n_clients:0.2f}"
-          f" (only epoch 1)")
-    print(f"Avg Upload Per Client: {epoch_upload / n_clients:0.2f}"
-          f" (only epoch 1)")
+    if mh.is_coordinator():
+        print(f"Total Download (MiB): {epoch_download:0.2f} (only epoch 1)")
+        print(f"Total Upload (MiB): {epoch_upload:0.2f} (only epoch 1)")
+        print(f"Avg Download Per Client: {epoch_download / n_clients:0.2f}"
+              f" (only epoch 1)")
+        print(f"Avg Upload Per Client: {epoch_upload / n_clients:0.2f}"
+              f" (only epoch 1)")
     return True
 
 
@@ -350,11 +371,15 @@ def build_model_and_params(cfg: Config, tokenizer, seq_len: int,
 def main(argv=None) -> bool:
     enable_persistent_compilation_cache()
     cfg = parse_args(default_lr=4e-2, argv=argv)
+    if cfg.multihost:
+        # must precede every backend touch (jax.device_count below)
+        mh.initialize_from_config(cfg)
     if cfg.do_test:
         # smoke shrink of the compression geometry (cv_train applies
         # the same pattern; reference cv_train.py:329-336)
         cfg = cfg.replace(num_rows=1, num_cols=1000, k=10, num_blocks=1)
-    print(cfg)
+    if mh.is_coordinator():
+        print(cfg)
     timer = Timer()
     np.random.seed(cfg.seed)
 
@@ -399,15 +424,26 @@ def main(argv=None) -> bool:
             num_slices=cfg.num_slices if cfg.num_slices > 1 else None)
         loss_train = tp_loss(loss_train, mesh)
         loss_val = tp_loss(loss_val, mesh)
-        print(f"tensor parallel: mesh {dict(mesh.shape)}")
+        if mh.is_coordinator():
+            print(f"tensor parallel: mesh {dict(mesh.shape)}")
 
     model = FedModel(None, loss_train, cfg, loss_val=loss_val,
                      params=params, mesh=mesh,
                      num_clients=train_loader.dataset.num_clients)
     opt = FedOptimizer(model)
 
+    coord = mh.is_coordinator()
+    if mh.is_multihost():
+        # per-process batch feeding: this controller materializes only
+        # the round-batch rows its devices own
+        train_loader.feed_slice = mh.local_row_slice(
+            model.mesh, cfg.num_workers)
+        val_loader.feed_slice = mh.local_row_slice(
+            model.mesh, val_loader.num_shards)
+
     spe = train_loader.steps_per_epoch
-    print("Steps per epoch", spe)
+    if coord:
+        print("Steps per epoch", spe)
     schedule = PiecewiseLinear([0, cfg.num_epochs * spe],
                                [cfg.lr_scale, 0.0])
     lr_scheduler = LambdaLR(opt, lr_lambda=schedule)
@@ -416,27 +452,26 @@ def main(argv=None) -> bool:
     ckpt_path = os.path.join(cfg.checkpoint_path, "gpt2")
     if cfg.resume and os.path.exists(ckpt_path + ".npz"):
         ckpt = load_checkpoint(ckpt_path)
-        model.server = ckpt.server
-        if ckpt.clients is not None:
-            model.clients = ckpt.clients
-        if ckpt.accountant_state:
-            model.accountant.load_state_dict(ckpt.accountant_state)
-        if ckpt.prev_change_words is not None:
-            model._prev_change_words = ckpt.prev_change_words
-        lr_scheduler.load_state_dict({"step_count": ckpt.scheduler_step})
-        print(f"resumed from {ckpt_path} at round "
-              f"{int(ckpt.server.round_idx)}")
+        lr_scheduler.load_state_dict(
+            {"step_count": model.load_state(ckpt)})
+        if coord:
+            print(f"resumed from {ckpt_path} at round "
+                  f"{int(ckpt.server.round_idx)}")
 
-    log_dir = make_logdir(cfg)
-    print(f"Finished initializing in {timer():.2f} seconds")
+    # only the coordinator creates a run dir (its artifacts are the
+    # run's outputs; workers would just litter empty dirs)
+    log_dir = make_logdir(cfg) if coord else ""
+    if coord:
+        print(f"Finished initializing in {timer():.2f} seconds")
 
     if cfg.do_finetune:
-        test_gpt2(model, val_loader, timer=timer)
+        test_gpt2(model, val_loader, timer=timer,
+                  logger=TableLogger() if coord else NullLogger())
         ok = True
     else:
         ok = train_gpt2(model, opt, lr_scheduler, train_loader,
-                        cfg, logger=TableLogger(), timer=timer,
-                        log_dir=log_dir)
+                        cfg, logger=TableLogger() if coord else NullLogger(),
+                        timer=timer, log_dir=log_dir)
         save_checkpoint(os.path.join(log_dir, "gpt2"), model.server,
                         scheduler_step=lr_scheduler.step_count)
         if cfg.do_checkpoint:
@@ -446,9 +481,11 @@ def main(argv=None) -> bool:
                             prev_change_words=model._prev_change_words)
         # HF-style final artifact: tokenizer + config + weights
         # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
-        save_pretrained(log_dir, model.state_dict(), module.cfg,
-                        tokenizer)
-        test_gpt2(model, val_loader, timer=timer)
+        if coord:
+            save_pretrained(log_dir, model.state_dict(), module.cfg,
+                            tokenizer)
+        test_gpt2(model, val_loader, timer=timer,
+                  logger=TableLogger() if coord else NullLogger())
     model.finalize()
     return ok
 

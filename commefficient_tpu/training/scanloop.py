@@ -18,16 +18,21 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
                        span_cap: int,
                        emit: Callable[..., bool],
                        on_comm: Optional[Callable[[np.ndarray, np.ndarray],
-                                                  None]] = None) -> bool:
+                                                  None]] = None,
+                       on_flush: Optional[Callable[[int], None]] = None
+                       ) -> bool:
     """Drive scanned spans over `stream`, which yields
     (tag, client_ids, data_tuple, mask, lr) per round — the caller owns
     round-budget/epoch-boundary logic by just ending the stream.
 
-    Per flushed span: on_comm(download, upload) once (host accounting
-    totals), then emit(tag, *per_round_metric_rows) once per round IN
-    ORDER. emit returning False aborts immediately (the remaining
-    rounds of the span are neither emitted nor logged — matching the
-    unscanned loop, which stops at the first bad round).
+    Per flushed span: on_flush(n_rounds) once as soon as the span's
+    device program has returned (per-round wall-time attribution — a
+    scanned span has no per-round boundaries, so callers amortize),
+    then on_comm(download, upload) once (host accounting totals), then
+    emit(tag, *per_round_metric_rows) once per round IN ORDER. emit
+    returning False aborts immediately (the remaining rounds of the
+    span are neither emitted nor logged — matching the unscanned loop,
+    which stops at the first bad round).
 
     Returns True if every emit succeeded, False on abort.
     """
@@ -40,6 +45,8 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
                   for i in range(len(datas[0]))),
             np.stack(masks), np.asarray(lrs))
         *metric_rows, down, up = out
+        if on_flush is not None:
+            on_flush(len(ids))
         if on_comm is not None:
             on_comm(down, up)
         for n in range(len(ids)):

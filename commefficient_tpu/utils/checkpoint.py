@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from commefficient_tpu.federated.round import ClientState, ServerState
+from commefficient_tpu.parallel import multihost as mh
 
 
 class Checkpoint(NamedTuple):
@@ -37,7 +38,8 @@ def save_checkpoint(path: str, server: ServerState,
                     scheduler_step: int = 0,
                     include_clients: bool = True,
                     accountant=None,
-                    prev_change_words: Optional[np.ndarray] = None) -> str:
+                    prev_change_words: Optional[np.ndarray] = None,
+                    chunk_rows: int = 256) -> str:
     """Write training state to `path` (.npz appended if absent).
     Per-client state can be excluded (include_clients=False) to keep
     files small when clients are stateless (error_type != local and
@@ -47,25 +49,56 @@ def save_checkpoint(path: str, server: ServerState,
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if not path.endswith(".npz"):
         path = path + ".npz"
+    # gather_host: per-client state is cross-process sharded in
+    # multi-controller runs. The gathers are collective — every process
+    # must reach this call — but only the coordinator writes the file
+    # (guard below), the reference's rank-0-saves discipline. The big
+    # [num_clients, D] blocks go through the CHUNKED gather so
+    # non-coordinator hosts never materialize them whole (multihost.
+    # zeros' own no-host-global-materialization rule).
     arrays = {
-        "ps_weights": np.asarray(server.ps_weights),
-        "Vvelocity": np.asarray(server.Vvelocity),
-        "Verror": np.asarray(server.Verror),
-        "round_idx": np.asarray(server.round_idx),
+        "ps_weights": mh.gather_host(server.ps_weights),
+        "Vvelocity": mh.gather_host(server.Vvelocity),
+        "Verror": mh.gather_host(server.Verror),
+        "round_idx": mh.gather_host(server.round_idx),
         "scheduler_step": np.asarray(scheduler_step),
     }
     if include_clients and clients is not None:
-        arrays["client_errors"] = np.asarray(clients.errors)
-        arrays["client_velocities"] = np.asarray(clients.velocities)
-        arrays["client_weights"] = np.asarray(clients.weights)
+        arrays["client_errors"] = _gather_rows(clients.errors, chunk_rows)
+        arrays["client_velocities"] = _gather_rows(clients.velocities,
+                                                   chunk_rows)
+        arrays["client_weights"] = _gather_rows(clients.weights, chunk_rows)
     if accountant is not None:
         for k, v in accountant.state_dict().items():
             arrays[f"acct_{k}"] = v
     if prev_change_words is not None:
         arrays["acct_prev_change_words"] = np.asarray(prev_change_words)
-    with open(path, "wb") as f:
-        np.savez(f, **arrays)
+    if mh.is_coordinator():
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+    mh.sync_processes("checkpoint-written")
     return path
+
+
+def _gather_rows(x, chunk_rows: int = 256):
+    """Gather a clients-sharded [rows, D] block to the COORDINATOR's
+    host in bounded chunks: every process participates in each chunk's
+    collective gather, but only the coordinator accumulates the full
+    array — non-coordinators' transient peak is one chunk. Returns the
+    full array on the coordinator, an empty placeholder elsewhere."""
+    if (not mh.is_multihost() or getattr(x, "ndim", 1) < 2
+            or x.shape[0] <= chunk_rows):
+        return mh.gather_host(x)
+    rows = x.shape[0]
+    out = (np.empty(x.shape, np.dtype(x.dtype))
+           if mh.is_coordinator() else None)
+    for lo in range(0, rows, chunk_rows):
+        hi = min(lo + chunk_rows, rows)
+        block = mh.gather_host(x[lo:hi])
+        if out is not None:
+            out[lo:hi] = block
+        del block
+    return out if out is not None else np.zeros((0,), np.float32)
 
 
 def load_checkpoint(path: str) -> Checkpoint:

@@ -34,6 +34,15 @@ class TableLogger:
         print(*row)
 
 
+class NullLogger:
+    """Swallows rows — non-coordinator processes of a multi-controller
+    run log nothing (the reference's workers likewise leave stdout to
+    the rank-0 PS)."""
+
+    def append(self, output: dict):
+        pass
+
+
 class TSVLogger:
     def __init__(self):
         self.log = ["epoch,hours,top1Accuracy"]

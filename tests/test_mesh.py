@@ -93,3 +93,41 @@ def test_sketch_round_matches_single_slice_mesh():
         new_server, _, _ = train_round(server, clients, batch, 0.1, key)
         results.append(np.asarray(new_server.ps_weights))
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+
+
+class _FakeDev:
+    """Minimal stand-in with the slice_index attribute the balanced
+    prefix reads (CPU test devices report none)."""
+
+    def __init__(self, i, sl):
+        self.id = i
+        self.slice_index = sl
+
+    def __repr__(self):
+        return f"FakeDev({self.id}, slice={self.slice_index})"
+
+
+def test_slice_balanced_prefix_single_slice_is_flat_prefix():
+    from commefficient_tpu.parallel.mesh import slice_balanced_prefix
+
+    devs = jax.devices()
+    assert slice_balanced_prefix(devs, 6) == devs[:6]
+    assert slice_balanced_prefix(devs, len(devs) + 1) is None
+
+
+def test_slice_balanced_prefix_multislice():
+    from commefficient_tpu.parallel.mesh import slice_balanced_prefix
+
+    # 2 slices x 4 devices
+    devs = [_FakeDev(i, i // 4) for i in range(8)]
+    # count=6 cannot split 3+3? it CAN: per=3 from each slice of 4
+    picked = slice_balanced_prefix(devs, 6)
+    assert [d.id for d in picked] == [0, 1, 2, 4, 5, 6]
+    # count=4 -> 2 per slice, slice-major
+    picked = slice_balanced_prefix(devs, 4)
+    assert [d.id for d in picked] == [0, 1, 4, 5]
+    # odd count over 2 slices is unbalanced -> None (flat fallback)
+    assert slice_balanced_prefix(devs, 5) is None
+    # more per slice than exists -> None
+    devs_small = [_FakeDev(i, i % 2) for i in range(4)]
+    assert slice_balanced_prefix(devs_small, 8) is None
