@@ -77,6 +77,13 @@ class Config:
     num_rows: int = 5
     num_blocks: int = 20
     do_topk_down: bool = False
+    # download top-k budget, decoupled from the upload/server k
+    # (0 = use k, the reference's single shared knob). The server's
+    # update is k-sparse per round while a sparsely-participating
+    # client accumulates MANY rounds of changes between downloads, so
+    # the download budget that keeps staleness bounded is a multiple
+    # of k — the tradeoff benchmarks/convergence.py sweeps.
+    down_k: int = 0
 
     # optimization (utils.py:150-162)
     local_momentum: float = 0.9
@@ -292,6 +299,9 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--num_rows", type=int, default=5)
     p.add_argument("--num_blocks", type=int, default=20)
     p.add_argument("--topk_down", action="store_true", dest="do_topk_down")
+    p.add_argument("--down_k", type=int, default=0,
+                   help="download top-k budget (0 = share --k); see "
+                        "Config.down_k")
 
     p.add_argument("--local_momentum", type=float, default=0.9)
     p.add_argument("--virtual_momentum", type=float, default=0)

@@ -199,9 +199,12 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         def one_client(cdata, cmask, err, vel, w_stale, key):
             if cfg.do_topk_down:
                 # download compression: client only receives the top-k
-                # of its weight staleness gap (fed_worker.py:232-247)
+                # of its weight staleness gap (fed_worker.py:232-247);
+                # down_k decouples the download budget from the
+                # upload/server k (Config.down_k)
                 diff = ps_weights - w_stale
-                weights = w_stale + masked_topk(diff, k=cfg.k)
+                weights = w_stale + masked_topk(diff,
+                                                k=cfg.down_k or cfg.k)
             else:
                 weights = ps_weights
 
