@@ -12,8 +12,10 @@ out="${1:-bench_out}"
 mkdir -p "$out"
 
 probe() {
-    timeout 60 python -c "import jax; print(jax.devices()[0].platform)" \
-        2>/dev/null | tail -1
+    # spawned-child probe: a hung tunnel blocks jax.devices() in C++
+    # where timeouts can't interrupt — probe_tpu.py hard-kills it
+    timeout 120 python benchmarks/probe_tpu.py 90 2>/dev/null \
+        | tail -1 | cut -d' ' -f1
 }
 
 echo "tunnel probe: $(probe || echo down)"
@@ -29,14 +31,16 @@ run gpt2       python benchmarks/bench_gpt2.py
 run local_topk python benchmarks/bench_local_topk.py
 run profile    python benchmarks/profile_round.py
 
-# convergence.py runs in-process (no child harness) and would wedge on
-# a hung tunnel — only attempt the full-geometry run when the probe
-# answers, and bound it with a hard timeout either way
+# the convergence scripts run in-process (no child harness) and would
+# wedge on a hung tunnel — only attempt when the probe answers, and
+# bound with hard timeouts either way
 if [ "$(probe)" = "tpu" ]; then
     run convergence_full \
-        env CONV_FULL=1 timeout 3600 python benchmarks/convergence.py
+        env CONV_FULL=1 timeout 5400 python benchmarks/convergence.py
+    run convergence_config3 \
+        timeout 3600 python benchmarks/convergence_config3.py
 else
-    echo "=== convergence_full skipped (tunnel down) ==="
+    echo "=== convergence runs skipped (tunnel down) ==="
 fi
 
 echo "logs in $out/; JSON lines are each log's last '{' line"
