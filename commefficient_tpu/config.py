@@ -265,6 +265,11 @@ class Config:
                 "uncompressed cannot use local error accumulation "
                 "(reference asserts this at fed_worker.py:221-222)"
             )
+        if self.down_k < 0:
+            raise ValueError("down_k must be >= 0 (0 = share the upload k)")
+        if self.down_k > self.grad_size > 0:
+            raise ValueError(
+                f"down_k={self.down_k} exceeds grad_size={self.grad_size}")
         return self
 
 

@@ -43,10 +43,18 @@ class FedLoader:
     def steps_per_epoch(self) -> int:
         return self.sampler.steps_per_epoch()
 
-    def epoch(self) -> Iterator[Tuple[np.ndarray, Tuple[np.ndarray, ...],
-                                      np.ndarray]]:
+    def epoch(self, skip: int = 0
+              ) -> Iterator[Tuple[np.ndarray, Tuple[np.ndarray, ...],
+                                  np.ndarray]]:
+        """skip: advance past the first `skip` rounds using sampler
+        index math only — no fetch/transform/materialization — for
+        O(1)-per-round mid-epoch resume fast-forward (the sampler's RNG
+        state still advances identically to a full epoch)."""
         B = self.sampler.round_batch_size
         for r in self.sampler.epoch():
+            if skip > 0:
+                skip -= 1
+                continue
             W = len(r.client_ids)
             rows = (range(W) if self.feed_slice is None
                     else range(*self.feed_slice.indices(W)))
@@ -70,7 +78,7 @@ class FedLoader:
                 for buf, g in zip(data, got):
                     buf[i, :n_valid] = g
             mask = (r.mask if self.feed_slice is None
-                    else r.mask[rows.start:rows.stop])
+                    else r.mask[self.feed_slice])
             yield r.client_ids, data, mask
 
 

@@ -153,6 +153,13 @@ class FedModel:
         # on host and globalized per call.
         self.lr_scale_vec = (None if lr_scale_vec is None
                              else np.asarray(lr_scale_vec, np.float32))
+        # global-feeding fallback for meshes where a process's devices
+        # are NOT a contiguous block of the clients axis (multihost.
+        # local_row_slice raises there): every process materializes the
+        # identical GLOBAL batch and it is placed per-shard via
+        # globalize's callback — correct for any device->process
+        # layout, at the cost of host-materializing the full batch.
+        self.feed_global = False
 
     # -- reference API surface -------------------------------------------
     def train(self, training: bool):
@@ -204,6 +211,18 @@ class FedModel:
         return ckpt.scheduler_step
 
     # -- internals --------------------------------------------------------
+    def _feed(self, rows, leading_axes: int = 0):
+        """Place one round-batch leaf on the mesh: per-process local
+        rows via shard_rows (the default), or — under the feed_global
+        fallback — the full global value via globalize with the same
+        clients-sharded spec."""
+        if self.feed_global:
+            P = self._P
+            spec = P(*([None] * leading_axes), "clients",
+                     *([None] * (np.ndim(rows) - leading_axes - 1)))
+            return mh.globalize(self.mesh, spec, rows)
+        return mh.shard_rows(self.mesh, rows, leading_axes=leading_axes)
+
     def _lr(self):
         if self._optimizer is None:
             raise RuntimeError("attach a FedOptimizer before training")
@@ -235,8 +254,8 @@ class FedModel:
             fround.RoundBatch(
                 mh.globalize(self.mesh, P(),
                              np.asarray(client_ids, np.int32)),
-                tuple(mh.shard_rows(self.mesh, d) for d in data),
-                mh.shard_rows(self.mesh, mask)),
+                tuple(self._feed(d) for d in data),
+                self._feed(mask)),
             lr, self._key)
 
         # Communication accounting with ONE round of lag: this round's
@@ -284,9 +303,9 @@ class FedModel:
                 fround.RoundBatch(
                     mh.globalize(self.mesh, P(),
                                  np.asarray(client_ids, np.int32)),
-                    tuple(mh.shard_rows(self.mesh, d, leading_axes=1)
+                    tuple(self._feed(d, leading_axes=1)
                           for d in data),
-                    mh.shard_rows(self.mesh, mask, leading_axes=1)),
+                    self._feed(mask, leading_axes=1)),
                 mh.globalize(self.mesh, P(), lrs), self._key))
 
         download = np.zeros(self.num_clients)
@@ -322,8 +341,8 @@ class FedModel:
         data, mask = batch
         loss, mets, count = self._eval_batch(
             self.server.ps_weights,
-            tuple(mh.shard_rows(self.mesh, d) for d in data),
-            mh.shard_rows(self.mesh, mask))
+            tuple(self._feed(d) for d in data),
+            self._feed(mask))
         return [mh.gather_host(loss), *[mh.gather_host(m) for m in mets],
                 mh.gather_host(count)]
 

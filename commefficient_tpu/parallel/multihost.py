@@ -167,6 +167,30 @@ def local_row_slice(mesh: Mesh, num_rows: int) -> slice:
     return slice(lo * rows_per_shard, (hi + 1) * rows_per_shard)
 
 
+def apply_feed_slices(model, train_loader, val_loader,
+                      num_train_rows: int, num_val_rows: int) -> None:
+    """Driver-side wiring of per-process batch feeding (both trainers
+    share it — the invariants are subtle enough to keep in ONE place):
+    compute BOTH row slices before assigning either, so a failure can't
+    leave one loader local and the other global; on the non-contiguity
+    error only, engage the documented globalize() fallback
+    (FedModel.feed_global); anything else (e.g. divisibility) is a
+    config error and re-raises."""
+    try:
+        train_sl = local_row_slice(model.mesh, num_train_rows)
+        val_sl = local_row_slice(model.mesh, num_val_rows)
+    except ValueError as e:
+        if "globalize" not in str(e):
+            raise
+        model.feed_global = True
+        if is_coordinator():
+            print(f"non-contiguous device layout ({e}); "
+                  "feeding batches globally via globalize()")
+    else:
+        train_loader.feed_slice = train_sl
+        val_loader.feed_slice = val_sl
+
+
 def _clients_axis_devices(mesh: Mesh):
     """Mesh devices along the clients axis (first model-column when a
     model axis exists: the clients coordinate determines the row

@@ -139,6 +139,11 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
     # (restored round_idx) count against it
     rounds_done = int(model.server.round_idx)
     epoch = rounds_done // spe
+    # mid-epoch resume: fast-forward the first resumed epoch's stream
+    # past the rounds already trained — sampler index math only, no
+    # batch materialization (FedLoader.epoch(skip=); symmetric with
+    # gpt2_train's fast-forward)
+    skip_rounds = rounds_done % spe
     total_down = np.zeros(model.num_clients)
     total_up = np.zeros(model.num_clients)
 
@@ -157,6 +162,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 os.path.join(log_dir or ".", "profile"))
             profiling = profiled = True
         epoch_rounds = min(spe, total_rounds - rounds_done)
+        epoch_stream = train_loader.epoch(skip=skip_rounds)
+        skip_rounds = 0
         losses, accs = [], []
         down = np.zeros(model.num_clients)
         up = np.zeros(model.num_clients)
@@ -184,7 +191,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
             def stream():
                 nonlocal taken
-                for client_ids, data, mask in train_loader.epoch():
+                for client_ids, data, mask in epoch_stream:
                     if taken == epoch_rounds:
                         return
                     lr_scheduler.step()
@@ -231,7 +238,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 return not np.isnan(losses[-1])
 
             pending = None
-            for client_ids, data, mask in train_loader.epoch():
+            for client_ids, data, mask in epoch_stream:
                 if rounds_done >= total_rounds:
                     break
                 lr_scheduler.step()
@@ -390,12 +397,11 @@ def main(argv=None) -> bool:
     opt = FedOptimizer(model)
 
     if mh.is_multihost():
-        # per-process batch feeding: this controller materializes only
-        # the round-batch rows its devices own
-        train_loader.feed_slice = mh.local_row_slice(
-            model.mesh, cfg.num_workers)
-        val_loader.feed_slice = mh.local_row_slice(
-            model.mesh, val_loader.num_shards)
+        # per-process batch feeding — or, on non-contiguous layouts,
+        # the globalize() fallback (one shared implementation:
+        # multihost.apply_feed_slices)
+        mh.apply_feed_slices(model, train_loader, val_loader,
+                             cfg.num_workers, val_loader.num_shards)
 
     if cfg.resume and os.path.exists(_ckpt_path(cfg) + ".npz"):
         ckpt = load_checkpoint(_ckpt_path(cfg))

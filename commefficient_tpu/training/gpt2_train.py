@@ -198,11 +198,11 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
         pending = None
         aborted = False
-        epoch_stream = train_loader.epoch()
-        if skip_rounds:
-            for _ in range(skip_rounds):
-                next(epoch_stream, None)
-            skip_rounds = 0
+        # sampler-level skip: the skipped rounds advance index math
+        # only, never materializing batch data (O(skip) host work was
+        # O(skip × batch fetch+transform) before)
+        epoch_stream = train_loader.epoch(skip=skip_rounds)
+        skip_rounds = 0
         if cfg.scan_rounds:
             # scanned device programs, flushed every --scan_span rounds
             # (symmetric with cv_train; bounds the staged token arrays)
@@ -434,12 +434,11 @@ def main(argv=None) -> bool:
 
     coord = mh.is_coordinator()
     if mh.is_multihost():
-        # per-process batch feeding: this controller materializes only
-        # the round-batch rows its devices own
-        train_loader.feed_slice = mh.local_row_slice(
-            model.mesh, cfg.num_workers)
-        val_loader.feed_slice = mh.local_row_slice(
-            model.mesh, val_loader.num_shards)
+        # per-process batch feeding — or, on non-contiguous layouts,
+        # the globalize() fallback (one shared implementation:
+        # multihost.apply_feed_slices)
+        mh.apply_feed_slices(model, train_loader, val_loader,
+                             cfg.num_workers, val_loader.num_shards)
 
     spe = train_loader.steps_per_epoch
     if coord:

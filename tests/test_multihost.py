@@ -126,3 +126,60 @@ def test_two_process_grid_matches_single_process(tmp_path):
     from commefficient_tpu.parallel.mh_worker import run_grid_vs_reference
 
     run_grid_vs_reference(str(tmp_path), timeout=600)
+
+
+@pytest.mark.slow
+def test_two_process_tp_grid_matches_single_process(tmp_path):
+    """Multihost × tensor parallelism: the same grid proof on a
+    (4 clients × 2 model) mesh with a tp-wrapped Megatron-sandwich
+    loss — GSPMD model-axis collectives running inside the manual
+    clients-axis shard_map across two controller processes, with
+    per-process row feeding (each process's devices are client rows
+    {0,1} / {2,3})."""
+    from commefficient_tpu.parallel.mh_worker import run_grid_vs_reference
+
+    run_grid_vs_reference(str(tmp_path), timeout=600, variant="tp")
+
+
+@pytest.mark.slow
+def test_noncontiguous_layout_globalize_fallback(tmp_path):
+    """Non-process-major device layouts (real pods can produce them):
+    the emulated slice-major permutation puts process 0's devices at
+    clients positions {0,1,4,5}, local_row_slice raises, and the run
+    must take the documented globalize() fallback
+    (FedModel.feed_global) — and still match the single-process
+    reference bitwise-close. The grid artifact records feed_global=1,
+    so a silently-skipped fallback fails the test."""
+    from commefficient_tpu.parallel.mh_worker import run_grid_vs_reference
+
+    run_grid_vs_reference(str(tmp_path), timeout=600, variant="noncontig")
+
+
+def test_local_row_slice_raises_on_noncontiguous_positions(monkeypatch):
+    """Closed-form check of the contiguity guard itself: a stub mesh
+    with the emulated slice-major device order ([d0,d2,d4,d6,d1,d3,
+    d5,d7], devices 0-3 on process 0) puts process 0 at clients
+    positions {0,1,4,5} — the guard must raise and point at
+    globalize() (the spawned grid test above exercises the real
+    fallback; this pins the guard's logic without process spawns)."""
+    import jax
+
+    class FakeDev:
+        def __init__(self, pid):
+            self.process_index = pid
+
+    class FakeMesh:
+        axis_names = ("clients",)
+        # device ids in slice-major order; process = id // 4
+        devices = np.array([FakeDev(i // 4)
+                            for i in (0, 2, 4, 6, 1, 3, 5, 7)])
+
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    with pytest.raises(ValueError, match="globalize"):
+        mh.local_row_slice(FakeMesh(), 8)
+    # the contiguous layout with the same stub machinery still works
+    class ContigMesh:
+        axis_names = ("clients",)
+        devices = np.array([FakeDev(i // 4) for i in range(8)])
+
+    assert mh.local_row_slice(ContigMesh(), 8) == slice(0, 4)
