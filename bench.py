@@ -485,9 +485,38 @@ def worker_entry(main_fn) -> int:
         return 3
 
 
+def artifact_dest(path: str, platform: str) -> str:
+    """Where a results-JSON should be written so a CPU-degraded rerun
+    never clobbers a landed TPU artifact: if `path` already records
+    platform=="tpu" (top-level or under "config") and this run is not
+    TPU, divert to the *_cpu.json sibling. Shared by every
+    file-artifact measurement script (gpt2_full_smoke, real_format_data,
+    convergence)."""
+    if platform == "tpu" or not os.path.isfile(path):
+        return path
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except Exception:
+        return path
+    plat = None
+    if isinstance(rec, dict):
+        plat = (rec.get("platform")
+                or rec.get("config", {}).get("platform"))
+    if plat == "tpu":
+        return path.replace(".json", "_cpu.json")
+    return path
+
+
 def _last_tpu_note() -> str:
-    """Cite the newest committed TPU artifact (by round number), with
-    its values read at runtime."""
+    """Cite the newest on-disk TPU artifact (by round number), with its
+    values read at runtime. Records without a vs_baseline are skipped
+    (an artifact the note can't contextualize shouldn't outrank one it
+    can). Tie-break at the same round: a driver-captured artifact
+    (BENCH_rN.json) outranks the builder-recorded one
+    (BENCH_rN_builder.json) — the driver's is the independently
+    captured measurement; the builder file is the mid-session fallback
+    kept for provenance."""
     import glob
     import re
 
@@ -508,17 +537,18 @@ def _last_tpu_note() -> str:
         # builder-recorded artifacts wrap the bench line in "parsed"
         rec = rec.get("parsed", rec)
         if (not isinstance(rec, dict) or rec.get("platform") != "tpu"
-                or rec.get("value") is None):
+                or rec.get("value") is None
+                or rec.get("vs_baseline") is None):
             continue
         key = (int(m.group(1)), 0 if m.group(2) else 1)
         if key > best_key:
             best, best_key = (os.path.basename(path), rec), key
     if best is None:
-        return ("TPU tunnel was down for this run and no committed "
-                "TPU artifact was found")
+        return ("TPU tunnel was down for this run and no TPU "
+                "artifact was found on disk")
     name, rec = best
     return (f"TPU tunnel was down for this run; last validated TPU "
-            f"measurement is committed in {name} "
+            f"measurement is recorded in {name} "
             f"({rec['value']:.1f} {rec.get('unit', 'ms/round')}, "
             f"vs_baseline {rec.get('vs_baseline')})")
 

@@ -54,17 +54,26 @@ from commefficient_tpu.utils.schedules import LambdaLR, PiecewiseLinear
 
 FULL = os.environ.get("CONV_FULL", "") == "1"
 EPOCHS = int(os.environ.get("CONV_EPOCHS", "12"))
+# seed variance (VERDICT r4 next #3): the cheap CPU suite runs every
+# config at 3 seeds and reports mean±spread; the FULL TPU run stays
+# single-seed (wall-clock) unless CONV_SEEDS overrides
+SEEDS = tuple(int(s) for s in os.environ.get(
+    "CONV_SEEDS", "0" if FULL else "0,1,2").split(","))
 WORKERS = 8
 BATCH = 32 if FULL else 8
+# the FULL (TPU) run gets its own artifact so it never clobbers the
+# cheap 3-seed CPU suite's results (both are committed evidence)
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "convergence_results.json")
+                   "convergence_full_results.json" if FULL
+                   else "convergence_results.json")
 
 
 def make_data(seed=0, num_clients=10):
     train_t, test_t = cifar10_transforms(seed=seed)
     n_train = 8192 if FULL else 1024
-    # sizing+partition-specific cache
-    root = f"/tmp/conv_bench_ds_{n_train}_{num_clients}"
+    # sizing+partition+seed-specific cache (the corpus itself is
+    # seeded, so seed variance covers data draw + init + sampling)
+    root = f"/tmp/conv_bench_ds_{n_train}_{num_clients}_{seed}"
     # default sizing targets the 8-device CPU mesh: ~20 s/round at the
     # old 8192x(16,32,32,32)-channel config made even a 2-epoch smoke
     # take an hour; 1024 examples x batch 8 x the narrower net below
@@ -82,7 +91,7 @@ def make_data(seed=0, num_clients=10):
 
 
 def run_mode(mode: str, train_set, val_set, seed=0, label=None,
-             down_k_mult=0):
+             down_k_mult=0, num_fedavg_epochs=1):
     D_kw = {} if FULL else {"channels": {"prep": 8, "layer1": 16,
                                          "layer2": 16, "layer3": 16}}
     # batchnorm on (the --do_batchnorm surface both frameworks expose):
@@ -139,8 +148,8 @@ def run_mode(mode: str, train_set, val_set, seed=0, label=None,
         # momentum at lr=1 (reference fed_worker.py:61-113)
         cfg = Config(mode="fedavg", error_type="none",
                      local_momentum=0.0, virtual_momentum=0.9,
-                     num_fedavg_epochs=1, fedavg_batch_size=BATCH,
-                     **base)
+                     num_fedavg_epochs=num_fedavg_epochs,
+                     fedavg_batch_size=BATCH, **base)
     elif mode == "local_topk":
         # upload = k floats -> 50x per-round upload compression
         cfg = Config(mode="local_topk", error_type="local",
@@ -203,11 +212,40 @@ def run_mode(mode: str, train_set, val_set, seed=0, label=None,
             "curve": curve}
 
 
+def seeded(label: str, fn) -> dict:
+    """Run `fn(seed)` (returning a run_mode dict) for every seed in
+    SEEDS; return seed-0's full record annotated with the per-seed
+    final accuracies, their mean, and spread (max-min). All summary
+    claims below are made on MEANS — a single seed's 2-point edge is
+    within spread at this scale (VERDICT r4 weak #3)."""
+    per_seed = [fn(s) for s in SEEDS]
+    rec = per_seed[0]
+    accs = [r["curve"][-1]["test_acc"] for r in per_seed]
+    rec["seeds"] = list(SEEDS)
+    rec["final_accs_per_seed"] = accs
+    rec["final_acc_mean"] = round(float(np.mean(accs)), 4)
+    rec["final_acc_spread"] = round(float(np.max(accs) - np.min(accs)), 4)
+    print(f"[{label}] final accs {accs} mean {rec['final_acc_mean']} "
+          f"spread {rec['final_acc_spread']}", flush=True)
+    return rec
+
+
 def main():
     t0 = time.time()
-    train_set, val_set = make_data()
-    runs = [run_mode(m, train_set, val_set)
+    data = {s: make_data(seed=s) for s in SEEDS}
+    runs = [seeded(m, lambda s, m=m: run_mode(m, *data[s], seed=s))
             for m in ("sketch", "uncompressed", "local_topk", "fedavg")]
+    # fedavg knob sweep (VERDICT r4 next #3): with local_batch -1 the
+    # sampler yields num_clients//num_workers = 10//8 -> ONE aggregation
+    # round per epoch, so fedavg trains 12 server rounds total where
+    # the per-batch modes train ~16x more — round starvation by config,
+    # not an optimizer bug. The reference's own knob for this regime is
+    # more local computation per round (num_fedavg_epochs,
+    # fed_worker.py:61-113); 4 local epochs at the same 12 rounds must
+    # close most of the gap if that explanation is right.
+    runs += [seeded("fedavg_e4", lambda s: run_mode(
+        "fedavg", *data[s], seed=s, label="fedavg_e4",
+        num_fedavg_epochs=4))]
     # download top-k pair at sparse participation: with 40 clients each
     # participates ~1 round in 5, accumulating several rounds of
     # changed coordinates between downloads — the regime --topk_down
@@ -217,80 +255,100 @@ def main():
     # (fed_aggregator.py:239-289) — so the measured effect here is the
     # accuracy cost of training on truncated weights, the trade-off
     # the paper reports for download compression, not a bytes delta.
-    train40, val40 = make_data(num_clients=40)
-    runs += [run_mode("sketch", train40, val40, label="sketch_40c"),
-             run_mode("sketch_topk_down", train40, val40,
-                      label="sketch_topk_down_40c")]
+    data40 = {s: make_data(seed=s, num_clients=40) for s in SEEDS}
+    runs += [seeded("sketch_40c", lambda s: run_mode(
+                 "sketch", *data40[s], seed=s, label="sketch_40c")),
+             seeded("sketch_topk_down_40c", lambda s: run_mode(
+                 "sketch_topk_down", *data40[s], seed=s,
+                 label="sketch_topk_down_40c"))]
     # download-k sweep: the k-vs-accuracy tradeoff curve for download
     # compression (down_k = upload k x {1 (above), 4, 16}); with each
     # client participating ~1 round in 5 and the server update k-sparse
     # per round, down_k ≈ 5k is where staleness stops accumulating —
     # the sweep brackets it
-    runs += [run_mode("sketch_topk_down", train40, val40,
-                      label=f"sketch_topk_down_40c_down{m}x",
-                      down_k_mult=m)
+    runs += [seeded(f"sketch_topk_down_40c_down{m}x",
+                    lambda s, m=m: run_mode(
+                        "sketch_topk_down", *data40[s], seed=s,
+                        label=f"sketch_topk_down_40c_down{m}x",
+                        down_k_mult=m))
              for m in (4, 16)]
     results = {
         "config": {"workers": WORKERS, "batch": BATCH, "epochs": EPOCHS,
-                   "full_model": FULL,
+                   "full_model": FULL, "seeds": list(SEEDS),
                    "platform": jax.devices()[0].platform,
-                   "num_clients": int(train_set.num_clients)},
+                   "num_clients": int(data[SEEDS[0]][0].num_clients)},
         "runs": runs,
     }
     results["wall_clock_s"] = round(time.time() - t0, 1)
 
     by_mode = {r["mode"]: r for r in results["runs"]}
-    sk = by_mode["sketch"]["curve"][-1]
-    un = by_mode["uncompressed"]["curve"][-1]
-    lt = by_mode["local_topk"]["curve"][-1]
-    fa = by_mode["fedavg"]["curve"][-1]
+
+    def acc(m):
+        return by_mode[m]["final_acc_mean"]
+
     un_floats = by_mode["uncompressed"]["upload_floats_per_client_round"]
     sk_ratio = un_floats / by_mode["sketch"]["upload_floats_per_client_round"]
     lt_ratio = un_floats / by_mode["local_topk"]["upload_floats_per_client_round"]
-    sk40 = by_mode["sketch_40c"]["curve"][-1]
-    td = by_mode["sketch_topk_down_40c"]["curve"][-1]
-    td4 = by_mode["sketch_topk_down_40c_down4x"]["curve"][-1]
-    td16 = by_mode["sketch_topk_down_40c_down16x"]["curve"][-1]
     results["summary"] = {
-        "sketch_final_acc": sk["test_acc"],
-        "uncompressed_final_acc": un["test_acc"],
-        "local_topk_final_acc": lt["test_acc"],
-        "fedavg_final_acc": fa["test_acc"],
-        "sketch_40c_final_acc": sk40["test_acc"],
-        "sketch_topk_down_40c_final_acc": td["test_acc"],
-        "sketch_topk_down_40c_down4x_final_acc": td4["test_acc"],
-        "sketch_topk_down_40c_down16x_final_acc": td16["test_acc"],
+        # every *_final_acc is the MEAN over config.seeds; per-seed
+        # values and spread live in each run record
+        "sketch_final_acc": acc("sketch"),
+        "uncompressed_final_acc": acc("uncompressed"),
+        "local_topk_final_acc": acc("local_topk"),
+        "fedavg_final_acc": acc("fedavg"),
+        "fedavg_e4_final_acc": acc("fedavg_e4"),
+        "sketch_40c_final_acc": acc("sketch_40c"),
+        "sketch_topk_down_40c_final_acc": acc("sketch_topk_down_40c"),
+        "sketch_topk_down_40c_down4x_final_acc":
+            acc("sketch_topk_down_40c_down4x"),
+        "sketch_topk_down_40c_down16x_final_acc":
+            acc("sketch_topk_down_40c_down16x"),
         "sketch_upload_compression_x": round(sk_ratio, 2),
         "local_topk_upload_compression_x": round(lt_ratio, 2),
+        "max_seed_spread": max(r["final_acc_spread"] for r in runs),
     }
-    with open(OUT, "w") as f:
+    import bench
+    with open(bench.artifact_dest(
+            OUT, results["config"]["platform"]), "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps(results["summary"]))
 
-    # the paper's qualitative claims, asserted
-    assert sk["test_acc"] > 0.5, "sketched training failed to learn"
-    assert sk["test_acc"] > un["test_acc"] - 0.05, \
+    # the paper's qualitative claims, asserted on seed MEANS
+    assert acc("sketch") > 0.5, "sketched training failed to learn"
+    assert acc("sketch") > acc("uncompressed") - 0.05, \
         "sketch fell behind uncompressed by more than a few points"
     assert sk_ratio >= 2.5, "sketch table not compressed (ref ratio 2.6x)"
-    assert lt["test_acc"] > un["test_acc"] - 0.1, \
+    assert acc("local_topk") > acc("uncompressed") - 0.1, \
         "local_topk fell far behind uncompressed"
     assert lt_ratio >= 10, "local_topk upload not >=10x compressed"
-    assert fa["test_acc"] > 0.5, "fedavg failed to learn"
+    assert acc("fedavg") > 0.5, "fedavg failed to learn"
+    # fedavg trains ~16x fewer aggregation rounds than the per-batch
+    # modes at this corpus (see sweep note above); 4 local epochs at
+    # the same round count must recover most of the uncompressed gap —
+    # the round-starvation explanation, asserted
+    assert acc("fedavg_e4") > acc("fedavg") + 0.1, \
+        "more local epochs failed to lift fedavg (round-starvation " \
+        "explanation would be wrong -> investigate as a bug)"
+    assert acc("fedavg_e4") > acc("uncompressed") - 0.15, \
+        "fedavg_e4 still far behind uncompressed"
     # topk_down trains on truncated stale weights; the paper reports
     # the same accuracy cost for download compression — learning (well
     # above 10-class chance), just behind full-download sketch
-    assert td["test_acc"] > 0.5, "sketch+topk_down failed to learn"
+    assert acc("sketch_topk_down_40c") > 0.5, \
+        "sketch+topk_down failed to learn"
     # the download-k tradeoff: a larger download budget must recover
     # (monotonically, within noise) toward the full-download sketch —
     # the k-vs-accuracy curve VERDICT r3 asked for. At down_k = 16k
     # (~D/3 per download vs ~5 server-rounds of k-sparse changes per
     # participation gap) the staleness truncation should cost almost
     # nothing.
-    assert td4["test_acc"] >= td["test_acc"] - 0.03, \
-        "down_k=4k fell below down_k=k"
-    assert td16["test_acc"] >= td4["test_acc"] - 0.03, \
+    assert acc("sketch_topk_down_40c_down4x") >= \
+        acc("sketch_topk_down_40c") - 0.03, "down_k=4k fell below down_k=k"
+    assert acc("sketch_topk_down_40c_down16x") >= \
+        acc("sketch_topk_down_40c_down4x") - 0.03, \
         "down_k=16k fell below down_k=4k"
-    assert td16["test_acc"] > sk40["test_acc"] - 0.06, \
+    assert acc("sketch_topk_down_40c_down16x") > \
+        acc("sketch_40c") - 0.06, \
         "a near-full download budget still far behind full download"
     print("convergence-under-compression: OK")
 

@@ -150,7 +150,9 @@ def main():
         "curve": curve,
         "wall_clock_s": round(time.time() - t0, 1),
     }
-    with open(OUT, "w") as f:
+    import bench
+    with open(bench.artifact_dest(
+            OUT, results["config"]["platform"]), "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps({"final_acc": curve[-1]["test_acc"],
                       "upload_compression_x":

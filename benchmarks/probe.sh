@@ -1,0 +1,15 @@
+# Shared tunnel probe for the TPU driver scripts (sourced by
+# run_tpu_suite.sh and tpu_watch.sh — one copy of the subtleties).
+#
+# probe: spawned-child probe via benchmarks/probe_tpu.py — a hung
+# tunnel blocks jax.devices() inside C++ where timeouts can't
+# interrupt, so the probe child is hard-killed. A crashed python
+# yields empty output; that maps to "down" here (the pipeline's exit
+# status is cut's, so `probe || echo down` at a call site would never
+# fire). Echoes one word: tpu / cpu / down.
+probe() {
+    local ans
+    ans="$(timeout 120 python benchmarks/probe_tpu.py 90 2>/dev/null \
+        | tail -1 | cut -d' ' -f1)"
+    echo "${ans:-down}"
+}

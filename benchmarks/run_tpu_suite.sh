@@ -11,14 +11,9 @@ cd "$(dirname "$0")/.."
 out="${1:-bench_out}"
 mkdir -p "$out"
 
-probe() {
-    # spawned-child probe: a hung tunnel blocks jax.devices() in C++
-    # where timeouts can't interrupt — probe_tpu.py hard-kills it
-    timeout 120 python benchmarks/probe_tpu.py 90 2>/dev/null \
-        | tail -1 | cut -d' ' -f1
-}
+. benchmarks/probe.sh
 
-echo "tunnel probe: $(probe || echo down)"
+echo "tunnel probe: $(probe)"
 
 run() { # name, cmd...
     local name="$1"; shift

@@ -1,0 +1,132 @@
+#!/usr/bin/env bash
+# All-session TPU retry loop (VERDICT r4 next-round #1: "make the
+# tunnel an all-session retry loop, not an end-of-round shot").
+#
+# Runs forever: every pass it probes the tunnel (spawned-child probe —
+# a hung tunnel blocks jax.devices() inside C++ where only a hard kill
+# works, benchmarks/probe_tpu.py), and when a TPU answers it runs the
+# next not-yet-landed measurement. A job is DONE only when its artifact
+# records platform == "tpu"; CPU-degraded runs are kept as logs but the
+# job stays queued for the next tunnel window. Jobs run strictly one at
+# a time (single chip).
+#
+# Usage:  bash benchmarks/tpu_watch.sh [outdir]    (default: bench_out)
+# Typically under tmux:  tmux new-session -d -s tpuwatch \
+#                          'bash benchmarks/tpu_watch.sh'
+set -u
+cd "$(dirname "$0")/.."
+out="${1:-bench_out}"
+mkdir -p "$out"
+SLEEP_DOWN="${TPU_WATCH_SLEEP:-300}"
+
+say() { echo "[tpu_watch $(date +%H:%M:%S)] $*"; }
+
+. benchmarks/probe.sh
+
+# platform recorded in the last JSON line of a log file ('' if none)
+log_platform() {
+    python - "$1" <<'EOF'
+import json, sys
+plat = ""
+try:
+    for line in open(sys.argv[1]):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                plat = json.loads(line).get("platform", "") or plat
+            except Exception:
+                pass
+except FileNotFoundError:
+    pass
+print(plat)
+EOF
+}
+
+# platform recorded in a results-JSON file under a dotted key path
+file_platform() {
+    python - "$1" "$2" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+    for k in sys.argv[2].split("."):
+        d = d[k]
+    print(d)
+except Exception:
+    print("")
+EOF
+}
+
+# Job table: name | check-kind(log/file:path.key) | timeout_s | cmd...
+# Bench scripts already survive a mid-run tunnel drop on their own
+# (bench.run_orchestrated hard-kills a hung TPU child and degrades to
+# CPU); the in-process convergence runs are bounded by the outer
+# timeout here instead.
+job_check() { # name -> echoes "tpu" when the job's artifact is a TPU run
+    case "$1" in
+        headline|gpt2|local_topk|profile|imagenet)
+            log_platform "$out/$1.log" ;;
+        convergence_full)
+            [ "$(file_platform benchmarks/convergence_full_results.json \
+                 config.platform)" = tpu ] \
+              && [ "$(file_platform benchmarks/convergence_full_results.json \
+                     config.full_model)" = True ] && echo tpu ;;
+        config3)
+            file_platform benchmarks/convergence_config3_results.json \
+                config.platform ;;
+        gpt2_full)
+            file_platform benchmarks/gpt2_full_results.json platform ;;
+        real_format)
+            file_platform benchmarks/real_format_results.json platform ;;
+    esac
+}
+
+job_cmd() { # name -> runs the job (stdout+stderr to its log)
+    case "$1" in
+        headline) timeout 3600 python bench.py ;;
+        gpt2) timeout 3600 python benchmarks/bench_gpt2.py ;;
+        local_topk) timeout 3600 python benchmarks/bench_local_topk.py ;;
+        profile) timeout 3600 python benchmarks/profile_round.py ;;
+        imagenet) timeout 3600 python benchmarks/bench_imagenet.py ;;
+        gpt2_full) timeout 5400 python benchmarks/gpt2_full_smoke.py ;;
+        convergence_full)
+            CONV_FULL=1 timeout 7200 python benchmarks/convergence.py ;;
+        config3) timeout 5400 python benchmarks/convergence_config3.py ;;
+        real_format) timeout 3600 python benchmarks/real_format_data.py ;;
+    esac
+}
+
+JOBS="headline gpt2 local_topk profile imagenet gpt2_full real_format config3 convergence_full"
+
+while :; do
+    pending=""
+    for j in $JOBS; do
+        # jobs whose script doesn't exist yet (added mid-session) are
+        # skipped this pass and picked up once written
+        case "$j" in
+            imagenet) [ -f benchmarks/bench_imagenet.py ] || continue ;;
+            gpt2_full) [ -f benchmarks/gpt2_full_smoke.py ] || continue ;;
+        esac
+        [ "$(job_check "$j")" = tpu ] || pending="$pending $j"
+    done
+    if [ -z "$pending" ]; then
+        say "all jobs landed on TPU; exiting"
+        break
+    fi
+    say "pending:$pending"
+    if [ "$(probe)" != tpu ]; then
+        say "tunnel down; sleeping ${SLEEP_DOWN}s"
+        sleep "$SLEEP_DOWN"
+        continue
+    fi
+    for j in $pending; do
+        say "tunnel up -> running $j"
+        job_cmd "$j" >"$out/$j.log" 2>&1
+        if [ "$(job_check "$j")" = tpu ]; then
+            say "$j: LANDED on TPU"
+        else
+            say "$j: did not land (degraded or failed); will retry"
+            # re-probe before burning time on the next job
+            [ "$(probe)" = tpu ] || break
+        fi
+    done
+done
