@@ -158,6 +158,27 @@ def main() -> int:
         ref_round_ms = bench.median_ms(serial_steps, (vec, data),
                                        divisor=ROUNDS) * NUM_WORKERS
 
+    # secondary measurement: the --bf16 round (bf16 client fwd/bwd on
+    # the MXU's native path, f32 master weights) — same reporting split
+    # as the flagship bench: primary value/vs_baseline stay the f32
+    # apples-to-apples comparison with the reference's fp32 CUDA path
+    bf16_round_ms = None
+    if platform == "tpu":
+        try:
+            tr_bf16 = fround.make_train_fn(
+                loss_fn, unravel, cfg.replace(do_bf16=True), mesh)
+            digest_bf16 = bench.make_run_digest(tr_bf16.train_rounds)
+            with bench.alarm_guard(STAGE_TIMEOUT, "bf16 compile+measure"):
+                float(np.asarray(digest_bf16(server, clients, batches,
+                                             lrs, key)))  # compile
+                bf16_round_ms = bench.median_ms(
+                    digest_bf16, (server, clients, batches, lrs, key),
+                    divisor=ROUNDS)
+        except bench.StageTimeout:
+            bench.log("bf16 measurement timed out; omitting")
+        except Exception as e:
+            bench.log(f"bf16 measurement failed: {e}")
+
     out = {
         "metric": "persona_gpt2s_sketch_round_time",
         "value": round(round_ms, 3),
@@ -171,7 +192,16 @@ def main() -> int:
         "num_candidates": CANDS,
         "grad_size": D,
     }
+    if bf16_round_ms is not None:
+        out["value_bf16"] = round(bf16_round_ms, 3)
+        out["vs_baseline_bf16"] = round(ref_round_ms / bf16_round_ms, 3)
     bench.add_flops_fields(out, flops_per_round, round_ms, device_kind)
+    if bf16_round_ms is not None and out.get("flops_per_round"):
+        bf16 = {}
+        bench.add_flops_fields(bf16, out["flops_per_round"],
+                               bf16_round_ms, device_kind)
+        if "mfu" in bf16:
+            out["mfu_bf16"] = bf16["mfu"]
     print(json.dumps(out), flush=True)
     return 0
 

@@ -21,6 +21,12 @@ SLEEP_DOWN="${TPU_WATCH_SLEEP:-300}"
 
 say() { echo "[tpu_watch $(date +%H:%M:%S)] $*"; }
 
+# leave a trace when the process dies (the session's process reaper
+# can take out daemons between loop iterations; the supervisor cron
+# relaunches on absence, and this line dates the gap)
+trap 'say "exiting (signal or EOF) pid=$$"' EXIT
+say "watcher started pid=$$"
+
 . benchmarks/probe.sh
 
 # platform recorded in the last JSON line of a log file ('' if none)
