@@ -40,6 +40,10 @@ LOCAL_BATCH = int(os.environ.get("IMAGENET_BENCH_BATCH", "64"))
 ROUNDS = int(os.environ.get("IMAGENET_BENCH_ROUNDS", "2"))
 MICRO = int(os.environ.get("IMAGENET_BENCH_MICRO", "8"))
 SMALL = os.environ.get("IMAGENET_BENCH_SMALL", "") == "1"
+# run the REAL 224px/1000-class geometry even on a CPU backend (an
+# execution proof of config #4 at real shapes when no TPU is
+# reachable; slow — minutes per round)
+FORCE_FULL = os.environ.get("IMAGENET_BENCH_FORCE_FULL", "") == "1"
 STAGE_TIMEOUT = int(os.environ.get("BENCH_STAGE_TIMEOUT", "900"))
 
 
@@ -62,7 +66,7 @@ def main() -> int:
     device_kind = jax.devices()[0].device_kind
     mesh = make_client_mesh(min(len(jax.devices()), NUM_WORKERS))
 
-    small = SMALL or platform == "cpu"
+    small = (SMALL or platform == "cpu") and not FORCE_FULL
     if small:
         px, batch, micro, classes = 64, 4, 2, 10
         model = build_model("FixupResNet50", num_classes=classes, width=8)

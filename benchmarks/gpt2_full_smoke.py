@@ -38,6 +38,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # repo-root harness
 
 SMALL = os.environ.get("GPT2_FULL_SMALL", "") == "1"
+# run the REAL 124M geometry even on a CPU backend (pipeline proof at
+# real scale when no TPU is reachable; slow — tens of seconds/round)
+FORCE_FULL = os.environ.get("GPT2_FULL_FORCE", "") == "1"
 ROUNDS = int(os.environ.get("GPT2_FULL_ROUNDS", "16"))
 WORKERS = int(os.environ.get("GPT2_FULL_WORKERS", "4"))
 BATCH = int(os.environ.get("GPT2_FULL_BATCH", "2"))
@@ -91,7 +94,7 @@ def main() -> int:
     from commefficient_tpu.training import gpt2_train
     from commefficient_tpu.utils.schedules import LambdaLR, PiecewiseLinear
 
-    small = SMALL or platform == "cpu"
+    small = (SMALL or platform == "cpu") and not FORCE_FULL
     t0 = time.time()
     with bench.alarm_guard(STAGE_TIMEOUT, "torch checkpoint"):
         ckpt_dir = make_torch_checkpoint(small)
