@@ -129,6 +129,12 @@ while :; do
         job_cmd "$j" >"$out/$j.log" 2>&1
         if [ "$(job_check "$j")" = tpu ]; then
             say "$j: LANDED on TPU"
+            if [ "$j" = headline ]; then
+                # snapshot the round-5 driver artifact (the last JSON
+                # line of the landed headline log)
+                grep '^{' "$out/headline.log" | tail -1 > BENCH_r05.json
+                say "headline TPU line snapshotted to BENCH_r05.json"
+            fi
         else
             say "$j: did not land (degraded or failed); will retry"
             # re-probe before burning time on the next job
