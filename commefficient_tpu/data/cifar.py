@@ -92,6 +92,26 @@ class FedCIFAR10(FedDataset):
     def _dir(self):
         return os.path.join(self.dataset_dir, self.dataset_name)
 
+    def _cached_stats_ok(self) -> bool:
+        """Re-prepare when the cached corpus isn't the one asked for:
+        a synthetic request must match the cached example counts
+        (real pickle archives on disk always win — prepare() prefers
+        them, so any cache derived from them is current)."""
+        if self._synthetic_examples is None:
+            return True
+        if _try_load_cifar_pickles(self.dataset_dir,
+                                   self.dataset_name) is not None:
+            return True
+        try:
+            import json
+            with open(self.stats_path()) as f:
+                stats = json.load(f)
+        except Exception:
+            return False
+        n_train, n_val = self._synthetic_examples
+        return (sum(stats["images_per_client"]) == n_train
+                and stats["num_val_images"] == n_val)
+
     def prepare(self, download: bool = False):
         loaded = _try_load_cifar_pickles(self.dataset_dir,
                                          self.dataset_name)

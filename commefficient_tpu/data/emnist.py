@@ -89,6 +89,24 @@ class FedEMNIST(FedDataset):
     def _npz_path(self, split: str) -> str:
         return os.path.join(self._dir(), f"{split}.npz")
 
+    def _cached_stats_ok(self) -> bool:
+        """Re-prepare when the cached corpus isn't the sizing asked
+        for (see FedDataset._cached_stats_ok); real LEAF shards on
+        disk always win."""
+        if self._synthetic_examples is None:
+            return True
+        if os.path.isdir(os.path.join(self._dir(), "raw", "train")):
+            return True
+        try:
+            import json
+            with open(self.stats_path()) as f:
+                stats = json.load(f)
+        except Exception:
+            return False
+        writers, per_writer = self._synthetic_examples
+        ipc = stats["images_per_client"]
+        return len(ipc) == writers and all(n == per_writer for n in ipc)
+
     def prepare(self, download: bool = False):
         raw_train = os.path.join(self._dir(), "raw", "train")
         raw_test = os.path.join(self._dir(), "raw", "test")

@@ -43,7 +43,8 @@ class FedDataset:
         if not do_iid and num_clients == 1:
             raise ValueError("can't have 1 client when non-iid")
 
-        if not os.path.exists(self.stats_path()):
+        if (not os.path.exists(self.stats_path())
+                or not self._cached_stats_ok()):
             self.prepare(download=download)
         self._load_meta()
 
@@ -74,6 +75,16 @@ class FedDataset:
             stats = json.load(f)
         self.images_per_client = np.array(stats["images_per_client"])
         self.num_val_images = int(stats["num_val_images"])
+
+    def _cached_stats_ok(self) -> bool:
+        """Is the on-disk prepared dataset the one THIS construction
+        asks for? Subclasses with a sized synthetic fallback override
+        this to compare the cached stats against the requested sizing —
+        without the check, constructing with different
+        `synthetic_examples` silently reuses whatever sizing was
+        prepared first in the same dataset_dir (a 2000-example cache
+        once served a run that asked for 400)."""
+        return True
 
     # ---- partition geometry --------------------------------------------
     @property

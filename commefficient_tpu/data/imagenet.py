@@ -48,6 +48,31 @@ class FedImageNet(FedDataset):
     def _pre(self, name):
         return os.path.join(self._dir(), "preprocessed", name)
 
+    def _cached_stats_ok(self) -> bool:
+        """Re-prepare when the cached synthetic corpus isn't the
+        sizing asked for (see FedDataset._cached_stats_ok); real
+        preprocessed/raw layouts on disk always win."""
+        if self._synthetic_examples is None:
+            return True
+        raw = os.path.join(self._dir(), "raw", "train")
+        # a preprocessed/ dir NOT written by _generate_synthetic is a
+        # real layout; the synthetic one is identified by its stats
+        # matching the deterministic generator geometry below
+        if os.path.isdir(raw):
+            return True
+        try:
+            import json
+            with open(self.stats_path()) as f:
+                stats = json.load(f)
+        except Exception:
+            return False
+        n_train, n_val = self._synthetic_examples
+        n_cls = min(NUM_CLASSES, 16)
+        per = max(n_train // n_cls, 1)
+        ipc = stats["images_per_client"]
+        return (len(ipc) == n_cls and all(n == per for n in ipc)
+                and stats["num_val_images"] == n_val)
+
     # ---- indexing -------------------------------------------------------
     def prepare(self, download: bool = False):
         if download:

@@ -249,3 +249,22 @@ def test_real_format_pickle_archive_feeds_real_reader(tmp_path):
     imgs, labels = ds.get_client_batch(3, np.arange(2))
     assert imgs.shape == (2, 32, 32, 3) and imgs.dtype == np.uint8
     assert np.all(labels == 3)
+
+
+def test_synthetic_resize_invalidates_cache(tmp_path):
+    # constructing with a DIFFERENT synthetic sizing in the same
+    # dataset_dir must regenerate, not silently serve the old corpus
+    # (a 2000-example cache once served a run that asked for 400)
+    ds_big = FedCIFAR10(str(tmp_path), synthetic_examples=(500, 100))
+    assert int(ds_big.data_per_client.sum()) == 500
+    ds_small = FedCIFAR10(str(tmp_path), synthetic_examples=(200, 40))
+    assert int(ds_small.data_per_client.sum()) == 200
+    assert ds_small.num_val_images == 40
+    # and re-asking for the current sizing does NOT regenerate (same
+    # stats object served from cache)
+    before = os.path.getmtime(
+        os.path.join(str(tmp_path), "CIFAR10", "stats.json"))
+    FedCIFAR10(str(tmp_path), synthetic_examples=(200, 40))
+    after = os.path.getmtime(
+        os.path.join(str(tmp_path), "CIFAR10", "stats.json"))
+    assert before == after
