@@ -141,8 +141,10 @@ def _sketched(sketched_grad, Vvelocity, Verror, cfg: Config, lr, key) -> ServerU
     # the error/momentum tables wherever the re-sketch landed
     # (reference fed_aggregator.py:593-611; note the reference
     # deliberately zeroes rather than subtracts — subtracting diverges
-    # per its own comment at :596-599).
-    sketched_update = sketch.encode_sparse(idx, vals)
+    # per its own comment at :596-599). encode_k_sparse picks the
+    # faster of the scatter-add / dense-rotation routes per geometry
+    # and backend (CSVec owns that heuristic).
+    sketched_update = sketch.encode_k_sparse(idx, vals, dense=update)
     not_sent = (sketched_update == 0).astype(Vvelocity.dtype)
     if cfg.error_type == "virtual":
         Verror = Verror * not_sent

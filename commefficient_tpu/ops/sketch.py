@@ -66,7 +66,7 @@ PERF.md):
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -213,6 +213,32 @@ class CSVec:
         return self.zeros().at[
             row_ids, buckets.reshape(-1)
         ].add((signs * vals[None, :]).reshape(-1))
+
+    def encode_k_sparse(self, indices: jax.Array, values: jax.Array,
+                        dense: Optional[jax.Array] = None) -> jax.Array:
+        """Sketch a k-sparse vector, choosing the faster of the two
+        mathematically identical routes (linearity — their equality is
+        asserted by tests/test_sketch.py):
+
+          * `encode_sparse`: O(r*k) scatter-add. Cheap everywhere when
+            k is small, and on CPU backends at any k.
+          * dense `encode(dense)`: O(r*d) contiguous rotations. TPU
+            scatter throughput is orders of magnitude below streaming
+            bandwidth, so past ~1M scattered elements (GPT2-small's
+            server re-sketch: r*k = 4.8M) the dense route wins.
+
+        `dense` is the already-materialized dense form of the sparse
+        vector, if the caller has one in hand (the server's
+        error-feedback step does); without it the dense route pays one
+        extra O(k) scatter to build it."""
+        use_dense = (self.r * int(indices.shape[0]) > 1_000_000
+                     and jax.default_backend() != "cpu")
+        if not use_dense:
+            return self.encode_sparse(indices, values)
+        if dense is None:
+            dense = jnp.zeros(self.d, jnp.float32).at[indices].set(
+                values, mode="drop")
+        return self.encode(dense)
 
     # --- decode ----------------------------------------------------------
     def estimate(self, table: jax.Array, idx: jax.Array) -> jax.Array:

@@ -65,6 +65,27 @@ def test_encode_sparse_matches_dense():
         s.encode_sparse(idx, vals), s.encode(dense), rtol=1e-5, atol=1e-5)
 
 
+def test_encode_k_sparse_routes_agree():
+    # encode_k_sparse must equal encode_sparse whichever route the
+    # geometry/backend heuristic picks (on the CPU test backend it
+    # always scatters; the dense route's equality is the linearity
+    # property asserted above — here we pin the dispatcher itself,
+    # including the caller-supplied `dense` form)
+    s = make_sketch(d=500, c=100, r=3, num_blocks=2)
+    idx = jnp.array([3, 77, 499, 500], jnp.int32)
+    vals = jnp.array([1.0, -2.0, 3.0, 99.0])
+    dense = jnp.zeros(s.d).at[idx[:3]].set(vals[:3])
+    want = np.asarray(s.encode_sparse(idx, vals))
+    np.testing.assert_allclose(
+        s.encode_k_sparse(idx, vals), want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        s.encode_k_sparse(idx, vals, dense=dense), want,
+        rtol=1e-5, atol=1e-5)
+    # and the dense route explicitly (what a big-k TPU run executes)
+    np.testing.assert_allclose(
+        s.encode(dense), want, rtol=1e-5, atol=1e-5)
+
+
 def test_l2estimate():
     s = CSVec(d=10000, c=5000, r=5, num_blocks=4)
     rng = np.random.RandomState(4)
