@@ -227,7 +227,9 @@ def orchestrate() -> int:
         if "PROF_CPU_TIMEOUT" in os.environ else None)
     if out is None:
         out = {"error": "all profile children failed or timed out"}
-    print(json.dumps(out, indent=1), flush=True)
+    # compact single-line JSON: tpu_watch.sh's log_platform parses the
+    # log line by line and cannot read an indented multi-line object
+    print(json.dumps(out), flush=True)
     return 0
 
 

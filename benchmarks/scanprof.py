@@ -228,7 +228,9 @@ def orchestrate() -> int:
                                  script=os.path.abspath(__file__))
     if out is None:
         out = {"error": "all scanprof children failed or timed out"}
-    print(json.dumps(out, indent=1), flush=True)
+    # compact single-line JSON: tpu_watch.sh's log_platform parses the
+    # log line by line and cannot read an indented multi-line object
+    print(json.dumps(out), flush=True)
     return 0
 
 

@@ -69,7 +69,7 @@ EOF
 # timeout here instead.
 job_check() { # name -> echoes "tpu" when the job's artifact is a TPU run
     case "$1" in
-        headline|gpt2|local_topk|profile|imagenet)
+        headline|gpt2|local_topk|profile|imagenet|scanprof)
             log_platform "$out/$1.log" ;;
         convergence_full)
             [ "$(file_platform benchmarks/convergence_full_results.json \
@@ -88,6 +88,7 @@ job_check() { # name -> echoes "tpu" when the job's artifact is a TPU run
 
 job_cmd() { # name -> runs the job (stdout+stderr to its log)
     case "$1" in
+        scanprof) timeout 3600 python benchmarks/scanprof.py ;;
         headline) timeout 3600 python bench.py ;;
         gpt2) timeout 3600 python benchmarks/bench_gpt2.py ;;
         local_topk) timeout 3600 python benchmarks/bench_local_topk.py ;;
@@ -101,7 +102,7 @@ job_cmd() { # name -> runs the job (stdout+stderr to its log)
     esac
 }
 
-JOBS="headline gpt2 local_topk profile imagenet gpt2_full real_format config3 convergence_full"
+JOBS="scanprof gpt2 local_topk config3 convergence_full headline profile imagenet gpt2_full real_format"
 
 while :; do
     pending=""

@@ -133,18 +133,26 @@ def _sketched(sketched_grad, Vvelocity, Verror, cfg: Config, lr, key) -> ServerU
         # replicated)
         decode_table = Vvelocity
 
-    idx, vals = sketch.decode_topk_sparse(decode_table, k=cfg.k)
-    update = jnp.zeros(cfg.grad_size, jnp.float32).at[idx].set(
-        vals, mode="drop")
+    if sketch._threshold_decode:
+        # large-d route: sampled-threshold heavy-hitter recovery (one
+        # mask, no big sort/gather/scatter — ops/sketch.py docs) and a
+        # contiguous dense re-encode
+        update = sketch.decode_topk_dense(decode_table, k=cfg.k)
+        sketched_update = sketch.encode(update)
+    else:
+        idx, vals = sketch.decode_topk_sparse(decode_table, k=cfg.k)
+        update = jnp.zeros(cfg.grad_size, jnp.float32).at[idx].set(
+            vals, mode="drop")
+        # encode_k_sparse picks the faster of the scatter-add /
+        # dense-rotation routes per geometry and backend (CSVec owns
+        # that heuristic)
+        sketched_update = sketch.encode_k_sparse(idx, vals, dense=update)
 
     # virtual error feedback: re-sketch the k-sparse update and zero
     # the error/momentum tables wherever the re-sketch landed
     # (reference fed_aggregator.py:593-611; note the reference
     # deliberately zeroes rather than subtracts — subtracting diverges
-    # per its own comment at :596-599). encode_k_sparse picks the
-    # faster of the scatter-add / dense-rotation routes per geometry
-    # and backend (CSVec owns that heuristic).
-    sketched_update = sketch.encode_k_sparse(idx, vals, dense=update)
+    # per its own comment at :596-599).
     not_sent = (sketched_update == 0).astype(Vvelocity.dtype)
     if cfg.error_type == "virtual":
         Verror = Verror * not_sent

@@ -86,6 +86,27 @@ STATIC_UNROLL_LIMIT = 2048
 # keeps live memory at O(c) (SURVEY.md §7.3 hard part #1).
 DECODE_MATERIALIZE_LIMIT = 256 * 1024 * 1024
 
+# Above this d, decode_topk_dense selects heavy hitters by SAMPLED
+# THRESHOLD instead of index top-k. Motivation (measured via
+# _jax.approx_top_k_reduction_output_size): at GPT2-small geometry
+# (d=124M, k=952k) the TPU ApproxTopK partial reduce only shrinks the
+# input 4x before its exact sort — a 31M-element sort per decode. The
+# threshold route estimates the k-th largest |estimate| from a ~1M
+# strided sample (a cheap approx_max_k), then selects every coordinate
+# >= that threshold with one elementwise mask: no large sort, no
+# gather, no scatter. The selected count is k +- sampling noise (~1-2%
+# at a 1M sample) rather than exactly k — the FetchSGD regime already
+# treats k as a budget on approximate sketch estimates, and error
+# feedback re-transmits anything a high threshold briefly excludes.
+# Small geometries (all golden tests, the flagship CV bench) keep
+# index top-k and its exact-k semantics. The gate is d-based, not
+# backend-based, so a given geometry has one semantics everywhere
+# (multihost bitwise-equality proofs compare like with like).
+THRESHOLD_DECODE_MIN_D = 32 * 1024 * 1024
+
+# sample size target for the threshold estimate
+_THRESHOLD_SAMPLE = 1024 * 1024
+
 
 @dataclasses.dataclass(frozen=True, eq=False)
 class CSVec:
@@ -277,12 +298,60 @@ class CSVec:
             (jnp.asarray(self._offsets).T, jnp.asarray(self._delta).T))
         return est                                            # [B, c]
 
+    def _flat_estimates(self, table: jax.Array) -> jax.Array:
+        """Materialized [padded] estimate vector with the padding tail
+        (coords >= d) zeroed — the shared prologue of both
+        materialize-path decode routes."""
+        flat = self.estimate_all(table).reshape(-1)
+        if self.n_chunks * self.c != self.d:
+            iota = jnp.arange(flat.shape[0], dtype=jnp.int32)
+            flat = jnp.where(iota < self.d, flat, 0.0)
+        return flat
+
     def decode_topk(self, table: jax.Array, k: int) -> jax.Array:
         """Dense [d] vector holding the k largest-magnitude estimated
         coordinates (reference csvec unSketch(k))."""
         sparse_idx, sparse_vals = self.decode_topk_sparse(table, k)
         dense = jnp.zeros(self.d, jnp.float32)
         return dense.at[sparse_idx].set(sparse_vals, mode="drop")
+
+    @property
+    def _threshold_decode(self) -> bool:
+        """Whether decode_topk_dense uses the sampled-threshold route
+        (see THRESHOLD_DECODE_MIN_D). Requires the materialized-
+        estimate path; beyond DECODE_MATERIALIZE_LIMIT the blockwise
+        sparse decode stays the only option."""
+        padded = self.n_chunks * self.c
+        return (self.d > THRESHOLD_DECODE_MIN_D
+                and padded <= DECODE_MATERIALIZE_LIMIT)
+
+    def decode_topk_dense(self, table: jax.Array, k: int) -> jax.Array:
+        """decode_topk for callers that only need the DENSE update
+        (the server's error-feedback step): at large d takes the
+        sampled-threshold route — one approx_max_k over a ~1M sample
+        plus one elementwise mask, instead of an index top-k whose TPU
+        partial-reduce sort grows with k*d — otherwise identical to
+        decode_topk."""
+        if not self._threshold_decode:
+            return self.decode_topk(table, k)
+
+        k = min(k, self.d)
+        flat = self._flat_estimates(table)
+        padded = flat.shape[0]
+        sq = flat * flat
+
+        stride = max(1, padded // _THRESHOLD_SAMPLE)
+        sample = sq[::stride]
+        # target the k-th largest of the padded vector: the sample's
+        # share of padding zeros mirrors the full vector's
+        ks = max(1, min(int(round(k * sample.shape[0] / padded)),
+                        sample.shape[0]))
+        vals, _ = jax.lax.approx_max_k(sample, ks)
+        # the ks-th largest sampled square ~ the k-th largest overall;
+        # max with tiny so an all-below-threshold-is-zero table (thr=0)
+        # selects exactly the nonzero estimates instead of everything
+        thr = jnp.maximum(vals[-1], jnp.finfo(jnp.float32).tiny)
+        return jnp.where(sq >= thr, flat, 0.0)[: self.d]
 
     def decode_topk_sparse(
         self, table: jax.Array, k: int
@@ -298,11 +367,7 @@ class CSVec:
             # materialize the full [B, c] estimate (28 MB at the
             # flagship geometry) and select once with the TPU-native
             # approx_max_k partial reduce (module perf notes).
-            est = self.estimate_all(table)
-            flat = est.reshape(-1)
-            if self.n_chunks * self.c != self.d:
-                iota = jnp.arange(flat.shape[0], dtype=jnp.int32)
-                flat = jnp.where(iota < self.d, flat, 0.0)
+            flat = self._flat_estimates(table)
             _, idx = jax.lax.approx_max_k(flat * flat, k)
             vals = flat[idx]
             idx = jnp.where(vals == 0.0, self.d, idx)

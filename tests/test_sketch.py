@@ -86,6 +86,61 @@ def test_encode_k_sparse_routes_agree():
         s.encode(dense), want, rtol=1e-5, atol=1e-5)
 
 
+def test_threshold_decode_matches_exact_at_full_sample(monkeypatch):
+    # with stride 1 (sample = full vector) the threshold route's
+    # selection IS the exact top-k (CPU approx_max_k is exact), so
+    # decode_topk_dense must equal decode_topk coordinate for
+    # coordinate
+    import commefficient_tpu.ops.sketch as sketch_mod
+    monkeypatch.setattr(sketch_mod, "THRESHOLD_DECODE_MIN_D", 1000)
+    s = CSVec(d=20000, c=5000, r=5, num_blocks=4)
+    assert s._threshold_decode
+    rng = np.random.RandomState(7)
+    v = jnp.asarray(rng.randn(s.d).astype(np.float32))
+    t = s.encode(v)
+    np.testing.assert_allclose(
+        s.decode_topk_dense(t, k=500), s.decode_topk(t, k=500),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_threshold_decode_sampled(monkeypatch):
+    # with a real subsample the selected count must land near k and
+    # the unambiguous heavy hitters must all be selected
+    import commefficient_tpu.ops.sketch as sketch_mod
+    monkeypatch.setattr(sketch_mod, "THRESHOLD_DECODE_MIN_D", 1000)
+    monkeypatch.setattr(sketch_mod, "_THRESHOLD_SAMPLE", 4096)
+    s = CSVec(d=40000, c=10000, r=5, num_blocks=4)
+    rng = np.random.RandomState(8)
+    v = rng.randn(s.d).astype(np.float32) * 0.01
+    hot = rng.choice(s.d, 50, replace=False)
+    v[hot] = rng.choice([-1.0, 1.0], 50) * (5.0 + rng.rand(50))
+    k = 2000
+    out = np.asarray(s.decode_topk_dense(s.encode(jnp.asarray(v)), k=k))
+    nz = np.nonzero(out)[0]
+    assert set(hot).issubset(set(nz))
+    # sampling noise on the count: ks = k*4096/40000 ~ 205 samples;
+    # binomial spread ~ 1/sqrt(205) ~ 7% -> generous 25% band
+    assert 0.75 * k <= len(nz) <= 1.25 * k, len(nz)
+
+
+def test_threshold_decode_sparser_than_k(monkeypatch):
+    # fewer than k nonzero estimates: thr hits 0 and the guard must
+    # select exactly the nonzero estimates, not everything
+    import commefficient_tpu.ops.sketch as sketch_mod
+    monkeypatch.setattr(sketch_mod, "THRESHOLD_DECODE_MIN_D", 100)
+    s = CSVec(d=5000, c=1000, r=5, num_blocks=4)
+    v = np.zeros(s.d, np.float32)
+    hot = np.array([7, 123, 999, 2500, 4999])
+    v[hot] = np.array([10.0, -8.0, 6.0, -12.0, 9.0], np.float32)
+    out = np.asarray(s.decode_topk_dense(s.encode(jnp.asarray(v)),
+                                         k=500))
+    np.testing.assert_allclose(out, v, atol=1e-4)
+    # nothing beyond the five true coordinates may be selected: a
+    # 5-sparse vector into c=1000 buckets leaves most buckets empty,
+    # so most estimates are exactly zero
+    assert len(np.nonzero(out)[0]) <= 5 * s.r
+
+
 def test_l2estimate():
     s = CSVec(d=10000, c=5000, r=5, num_blocks=4)
     rng = np.random.RandomState(4)
