@@ -123,6 +123,10 @@ def main():
         return vals.sum() * 1e-30 + s
     rec("approx_topk", chain_ms(approx_step))
 
+    rec("decode_threshold",
+        chain_ms(lambda s: sk.decode_topk_dense(
+            table + s, k).sum() * 1e-30 + s))
+
     rec("gather_vals",
         chain_ms(lambda s: (g + s)[kidx].sum() * 1e-30 + s))
     rec("scatter_update",
@@ -199,6 +203,16 @@ def main():
     rec("ltk_masked_topk_x8",
         chain_ms(lambda s: jnp.sum(
             masked_topk(g3 + s, k3)) * 1e-30 + s))
+
+    from commefficient_tpu.ops.flat import (
+        _topk_exact_1d, _topk_threshold_1d,
+    )
+    rec("ltk_topk_exact_x8",
+        chain_ms(lambda s: jnp.sum(jax.vmap(
+            lambda v: _topk_exact_1d(v, k3))(g3 + s)) * 1e-30 + s))
+    rec("ltk_topk_threshold_x8",
+        chain_ms(lambda s: jnp.sum(jax.vmap(
+            lambda v: _topk_threshold_1d(v, k3))(g3 + s)) * 1e-30 + s))
 
     cfg3 = Config(mode="local_topk", error_type="local",
                   local_momentum=0.9, virtual_momentum=0.0, k=k3,

@@ -28,31 +28,85 @@ def flatten_params(params) -> Tuple[jax.Array, Callable]:
     return vec.astype(jnp.float32), unravel
 
 
+# Above this d, masked_topk selects by SAMPLED THRESHOLD instead of
+# index top-k. Same motivation and regime as the sketch decoder's
+# THRESHOLD_DECODE_MIN_D (ops/sketch.py): ApproxTopK's partial reduce
+# shrinks the input only 4x at the reference's k/d ~ 1/130 ratio
+# (approx_top_k_reduction_output_size: d=5.25M, k=40402 -> a
+# 1.31M-element exact sort PER CLIENT at BASELINE config #3), where
+# the threshold route is one ~1M-sample approx_max_k plus an
+# elementwise mask. Selected count is k within ~1% sampling noise
+# rather than exactly k; every caller (true_topk/local_topk error
+# accumulation, topk_down staleness tracking) runs under error
+# feedback, which retransmits anything a high threshold briefly
+# excludes. Small geometries — all closed-form tests — keep exact-k
+# semantics. d-based, not backend-based, so a geometry has one
+# semantics everywhere.
+TOPK_THRESHOLD_MIN_D = 4 * 1024 * 1024
+
+_TOPK_SAMPLE = 1024 * 1024
+
+
 def masked_topk(vec: jax.Array, k: int) -> jax.Array:
-    """Dense vector equal to `vec` at its k largest-magnitude entries
+    """Dense vector equal to `vec` at its ~k largest-magnitude entries
     and zero elsewhere (reference `_topk`, utils.py:232-252).
 
     Works on 1-D [d] and batched 2-D [b, d] input (top-k taken per
     row), like the reference.
 
-    Selection is `jax.lax.approx_max_k`: on TPU the native
-    partial-reduce kernel (exact `lax.top_k` sorts the full vector —
-    ~9 ms at d=6.6M, k=50k on a v5e) recovering ~95% of the true
-    top-k; since every caller is a compression operator running under
-    error feedback (true_topk/local_topk error accumulation, topk_down
-    staleness), missed coordinates are transmitted on later rounds. On
-    CPU — where the golden tests run — approx_max_k is exact.
+    Below TOPK_THRESHOLD_MIN_D, selection is `jax.lax.approx_max_k`:
+    on TPU the native partial-reduce kernel (exact `lax.top_k` sorts
+    the full vector — ~9 ms at d=6.6M, k=50k on a v5e) recovering
+    ~95% of the true top-k; missed coordinates stay in the error
+    accumulator and transmit on later rounds. On CPU — where the
+    golden tests run — approx_max_k is exact. Above the gate, the
+    sampled-threshold route (constant's docstring) replaces the index
+    select entirely.
     """
+    d = vec.shape[-1]
+    one = (_topk_threshold_1d if d > TOPK_THRESHOLD_MIN_D
+           else _topk_exact_1d)
+
     def _topk_1d(v):
-        _, idx = jax.lax.approx_max_k(v * v, k)
-        mask = jnp.zeros_like(v).at[idx].set(1.0)
-        return v * mask
+        return one(v, k)
 
     if vec.ndim == 1:
         return _topk_1d(vec)
     elif vec.ndim == 2:
         return jax.vmap(_topk_1d)(vec)
     raise ValueError(f"masked_topk supports 1-D/2-D input, got {vec.ndim}-D")
+
+
+def _topk_exact_1d(v: jax.Array, k: int) -> jax.Array:
+    _, idx = jax.lax.approx_max_k(v * v, k)
+    mask = jnp.zeros_like(v).at[idx].set(1.0)
+    return v * mask
+
+
+def _topk_threshold_1d(v: jax.Array, k: int) -> jax.Array:
+    return sampled_threshold_mask(v, k)
+
+
+def sampled_threshold_mask(v: jax.Array, k: int) -> jax.Array:
+    """THE sampled-threshold selection (one algorithm, shared by
+    masked_topk's large-d route and CSVec.decode_topk_dense): estimate
+    the k-th largest v^2 from a ~_TOPK_SAMPLE strided sample, then
+    keep every coordinate at or above it. Coordinates the caller wants
+    excluded (e.g. a padding tail) must already be zero — zeros sort
+    last, so they dilute the sample and the selection identically and
+    the quantile math stays exact."""
+    d = v.shape[0]
+    k = min(k, d)
+    sq = v * v
+    stride = max(1, d // _TOPK_SAMPLE)
+    sample = sq[::stride]
+    ks = max(1, min(int(round(k * sample.shape[0] / d)),
+                    sample.shape[0]))
+    vals, _ = jax.lax.approx_max_k(sample, ks)
+    # tiny floor: a vector with fewer than k nonzeros (thr would be 0)
+    # selects exactly its nonzeros instead of everything
+    thr = jnp.maximum(vals[-1], jnp.finfo(jnp.float32).tiny)
+    return jnp.where(sq >= thr, v, 0.0)
 
 
 def clip_to_l2(vec: jax.Array, clip: float) -> jax.Array:

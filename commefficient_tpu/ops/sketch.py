@@ -101,11 +101,10 @@ DECODE_MATERIALIZE_LIMIT = 256 * 1024 * 1024
 # Small geometries (all golden tests, the flagship CV bench) keep
 # index top-k and its exact-k semantics. The gate is d-based, not
 # backend-based, so a given geometry has one semantics everywhere
-# (multihost bitwise-equality proofs compare like with like).
+# (multihost bitwise-equality proofs compare like with like). The
+# selection algorithm itself is ops/flat.py's sampled_threshold_mask
+# (one shared implementation).
 THRESHOLD_DECODE_MIN_D = 32 * 1024 * 1024
-
-# sample size target for the threshold estimate
-_THRESHOLD_SAMPLE = 1024 * 1024
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -335,23 +334,11 @@ class CSVec:
         if not self._threshold_decode:
             return self.decode_topk(table, k)
 
-        k = min(k, self.d)
+        from commefficient_tpu.ops.flat import sampled_threshold_mask
+        # the padding tail of _flat_estimates is already zeroed, which
+        # is exactly the contract sampled_threshold_mask needs
         flat = self._flat_estimates(table)
-        padded = flat.shape[0]
-        sq = flat * flat
-
-        stride = max(1, padded // _THRESHOLD_SAMPLE)
-        sample = sq[::stride]
-        # target the k-th largest of the padded vector: the sample's
-        # share of padding zeros mirrors the full vector's
-        ks = max(1, min(int(round(k * sample.shape[0] / padded)),
-                        sample.shape[0]))
-        vals, _ = jax.lax.approx_max_k(sample, ks)
-        # the ks-th largest sampled square ~ the k-th largest overall;
-        # max with tiny so an all-below-threshold-is-zero table (thr=0)
-        # selects exactly the nonzero estimates instead of everything
-        thr = jnp.maximum(vals[-1], jnp.finfo(jnp.float32).tiny)
-        return jnp.where(sq >= thr, flat, 0.0)[: self.d]
+        return sampled_threshold_mask(flat, min(k, self.d))[: self.d]
 
     def decode_topk_sparse(
         self, table: jax.Array, k: int

@@ -31,6 +31,47 @@ def test_masked_topk_matches_sort():
     np.testing.assert_allclose(out, expected)
 
 
+def test_masked_topk_threshold_matches_exact_at_full_sample(monkeypatch):
+    # with stride 1 the threshold route's selection IS the exact top-k
+    # (CPU approx_max_k is exact): above-gate masked_topk must equal
+    # the exact route coordinate for coordinate, 1-D and 2-D
+    monkeypatch.setattr(flat, "TOPK_THRESHOLD_MIN_D", 100)
+    rng = np.random.RandomState(5)
+    v = jnp.asarray(rng.randn(4, 3000).astype(np.float32))
+    k = 100
+    got = np.asarray(flat.masked_topk(v, k))
+    want = np.asarray(jax.vmap(lambda r: flat._topk_exact_1d(r, k))(v))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        flat.masked_topk(v[0], k), want[0], rtol=1e-6, atol=1e-6)
+
+
+def test_masked_topk_threshold_sampled(monkeypatch):
+    # real subsample: count near k, unambiguous heavy hitters all kept
+    monkeypatch.setattr(flat, "TOPK_THRESHOLD_MIN_D", 1000)
+    monkeypatch.setattr(flat, "_TOPK_SAMPLE", 4096)
+    rng = np.random.RandomState(6)
+    d, k = 40000, 2000
+    v = rng.randn(d).astype(np.float32) * 0.01
+    hot = rng.choice(d, 50, replace=False)
+    v[hot] = rng.choice([-1.0, 1.0], 50) * (5.0 + rng.rand(50))
+    out = np.asarray(flat.masked_topk(jnp.asarray(v), k))
+    nz = np.nonzero(out)[0]
+    assert set(hot).issubset(set(nz))
+    assert 0.75 * k <= len(nz) <= 1.25 * k, len(nz)
+    np.testing.assert_allclose(out[nz], v[nz])
+
+
+def test_masked_topk_threshold_sparser_than_k(monkeypatch):
+    # fewer than k nonzeros: the tiny floor keeps selection to exactly
+    # the nonzeros instead of everything
+    monkeypatch.setattr(flat, "TOPK_THRESHOLD_MIN_D", 100)
+    v = np.zeros(5000, np.float32)
+    v[[3, 1000, 4999]] = [2.0, -7.0, 0.5]
+    out = np.asarray(flat.masked_topk(jnp.asarray(v), 500))
+    np.testing.assert_allclose(out, v)
+
+
 def test_clip_to_l2_noop_below_threshold():
     v = jnp.array([0.3, 0.4])  # norm 0.5
     np.testing.assert_allclose(flat.clip_to_l2(v, 1.0), v)

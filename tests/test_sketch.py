@@ -108,7 +108,8 @@ def test_threshold_decode_sampled(monkeypatch):
     # the unambiguous heavy hitters must all be selected
     import commefficient_tpu.ops.sketch as sketch_mod
     monkeypatch.setattr(sketch_mod, "THRESHOLD_DECODE_MIN_D", 1000)
-    monkeypatch.setattr(sketch_mod, "_THRESHOLD_SAMPLE", 4096)
+    import commefficient_tpu.ops.flat as flat_mod
+    monkeypatch.setattr(flat_mod, "_TOPK_SAMPLE", 4096)
     s = CSVec(d=40000, c=10000, r=5, num_blocks=4)
     rng = np.random.RandomState(8)
     v = rng.randn(s.d).astype(np.float32) * 0.01
