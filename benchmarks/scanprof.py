@@ -183,15 +183,26 @@ def main():
                  tok((W, B, CANDS, L), V))
         bmask = jnp.ones((W, B), jnp.float32)
 
-        def fwd_bwd(v):
+        def fwd_bwd_vmap(v):
             def one(d, m):
                 def loss(vv):
                     l, _ = loss_fn(unravel(vv), d, m)
                     return l
                 return jax.grad(loss)(v)
             return jax.vmap(one)(bdata, bmask).sum(0)
-        rec("gpt2_fwd_bwd_x4",
-            chain_ms(lambda v: v - 1e-9 * fwd_bwd(v),
+        rec("gpt2_fwd_bwd_vmap_x4",
+            chain_ms(lambda v: v - 1e-9 * fwd_bwd_vmap(v),
+                     init=lambda: vec, iters=4))
+
+        def fwd_bwd_fused(v):
+            def total(vv):
+                def one(d, m):
+                    l, _ = loss_fn(unravel(vv), d, m)
+                    return l * m.sum()
+                return jax.vmap(one)(bdata, bmask).sum()
+            return jax.grad(total)(v)
+        rec("gpt2_fwd_bwd_fused_x4",
+            chain_ms(lambda v: v - 1e-9 * fwd_bwd_fused(v),
                      init=lambda: vec, iters=4))
 
     # ---- local_topk geometry -------------------------------------------

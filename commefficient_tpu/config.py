@@ -213,6 +213,29 @@ class Config:
         return (self.mode == "sketch" and not self.do_dp
                 and self.max_grad_norm is None)
 
+    @property
+    def fused_client_backward(self) -> bool:
+        """Backward-pass linearity optimization: when every per-client
+        transmit is a LINEAR function of that client's gradient — no
+        per-client DP/clipping, no per-client momentum/error state, no
+        per-client weight staleness (topk_down), and no local_topk
+        sparsification — the shard's summed transmit equals the
+        gradient of the count-weighted summed loss, so the round
+        engine runs ONE backward pass over all the shard's clients
+        instead of a vmapped per-client backward. That removes the
+        [W_shard, D] per-client gradient materialization (2 GB at
+        GPT2-small x 4 clients) and lets XLA batch the weight-grad
+        matmuls across clients; per-client losses/metrics still come
+        from the (cheap) per-client forward values. Microbatching is
+        gated out: the fused backward sees all clients' examples at
+        once, which is exactly what microbatch_size exists to avoid."""
+        return (self.mode in ("sketch", "uncompressed", "true_topk")
+                and not self.do_dp and self.max_grad_norm is None
+                and self.local_momentum == 0
+                and self.error_type != "local"
+                and not self.do_topk_down
+                and self.microbatch_size <= 0)
+
     def resolved_num_clients(self, dataset_num_clients: Optional[int] = None) -> int:
         if self.num_clients is not None:
             return self.num_clients

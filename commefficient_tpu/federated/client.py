@@ -240,6 +240,48 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
     return g, loss, metrics, total
 
 
+def fused_shard_grads(flat_loss_fn, weights, batch, mask,
+                      cfg: Config,
+                      grad_mask: Optional[jax.Array] = None):
+    """One backward pass for a whole shard of clients
+    (Config.fused_client_backward's gate guarantees this equals the
+    sum of per-client local_step transmits):
+
+        sum_c transmit_c = sum_c count_c * mean_grad_c
+                         = d/dw [ sum_c count_c * mean_loss_c ]
+
+    plus the weight-decay term, which every client adds as
+    (wd/num_workers) * w before the count scaling, so the shard sum
+    contributes (wd/num_workers) * w * total_count (reference
+    utils.py:254-259 semantics preserved).
+
+    batch/mask are the shard's [W_shard, B, ...] arrays. Returns
+    (grad_sum [D], losses [W_shard], metrics, counts [W_shard]) where
+    losses/metrics are per-client masked means — the same reporting
+    contract as the vmapped path.
+    """
+    def objective(vec):
+        def one(d, m):
+            loss, metrics = flat_loss_fn(vec, d, m)
+            return loss, metrics, m.sum()
+        losses, metrics, counts = jax.vmap(one)(batch, mask)
+        total = (losses * counts).sum()
+        return total, (losses, metrics, counts)
+
+    (_, (losses, metrics, counts)), grad_sum = jax.value_and_grad(
+        objective, has_aux=True)(weights)
+
+    if grad_mask is not None:
+        grad_sum = grad_sum * grad_mask
+    if cfg.weight_decay != 0:
+        wd_term = (cfg.weight_decay / cfg.num_workers) * weights \
+            * counts.sum()
+        if grad_mask is not None:
+            wd_term = wd_term * grad_mask
+        grad_sum = grad_sum + wd_term
+    return grad_sum, losses, metrics, counts
+
+
 def local_step(flat_grad_fn, weights, batch, mask, error, velocity,
                cfg: Config, key=None,
                grad_mask: Optional[jax.Array] = None) -> ClientResult:

@@ -173,9 +173,23 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
     gradients at all (cv_train.py:377-384).
     """
     cfg.validate()
+    # the fused path produces a dense shard gradient sum; in sketch
+    # mode the shared aggregation tail must therefore be the one to
+    # encode it. Today fused_client_backward's gate is a strict subset
+    # of defer_sketch_encode's — this assert keeps that implication
+    # from silently breaking if either gate gains a condition.
+    if cfg.fused_client_backward and cfg.mode == "sketch":
+        assert cfg.defer_sketch_encode, (
+            "fused_client_backward requires defer_sketch_encode in "
+            "sketch mode (dense shard sum must be encoded in the "
+            "shared tail)")
     flat_grad = fclient.make_flat_grad_fn(
         loss_fn, unravel,
         compute_dtype=jnp.bfloat16 if cfg.do_bf16 else None)
+    flat_loss = (fclient.make_flat_loss_fn(
+        loss_fn, unravel,
+        compute_dtype=jnp.bfloat16 if cfg.do_bf16 else None)
+        if cfg.fused_client_backward else None)
     if grad_mask is not None:
         grad_mask = jnp.asarray(grad_mask, jnp.float32)
     # clients sharded over the `clients` axis only — further axes
@@ -220,10 +234,27 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                      else jnp.zeros_like(cmask, shape=()))
             return res, new_w
 
-        results, new_w_rows = jax.vmap(one_client)(
-            data, mask, err_rows, vel_rows, w_rows, keys)
+        # only the client-compute step branches; the encode/psum
+        # aggregation tail below is shared, so the fused and
+        # per-client paths cannot drift apart
+        if cfg.fused_client_backward:
+            # one backward for the whole shard (gate guarantees
+            # equality with the per-client path — Config property and
+            # fclient.fused_shard_grads docstrings)
+            local_sum, losses, metrics, counts = fclient.fused_shard_grads(
+                flat_loss, ps_weights, data, mask, cfg,
+                grad_mask=grad_mask)
+            dummy = jnp.zeros_like(mask, shape=mask.shape[:1])
+            new_err = new_vel = new_w_rows = dummy
+        else:
+            results, new_w_rows = jax.vmap(one_client)(
+                data, mask, err_rows, vel_rows, w_rows, keys)
+            local_sum = jax.tree.map(
+                lambda t: t.sum(axis=0), results.transmit)
+            losses, metrics, counts = (
+                results.loss, results.metrics, results.num_examples)
+            new_err, new_vel = results.error, results.velocity
 
-        local_sum = jax.tree.map(lambda t: t.sum(axis=0), results.transmit)
         if cfg.defer_sketch_encode:
             # sketch linearity: encode the per-shard client sum ONCE
             # (clients returned dense gradients; see Config property
@@ -232,10 +263,9 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             # reference's NCCL reduce of sketch tables.
             local_sum = fserver.args2sketch(cfg).encode(local_sum)
         transmit = jax.lax.psum(local_sum, "clients")
-        total = jax.lax.psum(results.num_examples.sum(), "clients")
-        return (transmit, total, results.error, results.velocity,
-                new_w_rows, results.loss, results.metrics,
-                results.num_examples)
+        total = jax.lax.psum(counts.sum(), "clients")
+        return (transmit, total, new_err, new_vel, new_w_rows,
+                losses, metrics, counts)
 
     state_spec = P("clients")
 

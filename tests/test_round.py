@@ -64,6 +64,30 @@ def test_uncompressed_round_closed_form(mesh):
     assert metrics.num_examples.sum() == 32
 
 
+def test_fused_backward_matches_per_client_path(mesh):
+    # microbatch_size=B runs the same math as -1 (one microbatch) but
+    # disables Config.fused_client_backward, so the two rounds compare
+    # the fused single-backward against the vmapped per-client
+    # backward: weights, losses, metrics, counts must all agree
+    _, x, y = make_problem(seed=9)
+    mask = jnp.ones((8, 4)).at[3, 2:].set(0.0)  # ragged batch too
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y), mask)
+    key = jax.random.PRNGKey(0)
+    outs = []
+    for mb, want_fused in ((-1, True), (4, False)):
+        cfg, train_round, _, server, clients = setup(
+            mesh, mode="uncompressed", microbatch_size=mb,
+            weight_decay=1e-2)
+        assert cfg.fused_client_backward is want_fused
+        s2, _, metrics = train_round(server, clients, batch, 0.1, key)
+        outs.append((np.asarray(s2.ps_weights),
+                     np.asarray(metrics.losses),
+                     np.asarray(metrics.metrics[0]),
+                     np.asarray(metrics.num_examples)))
+    for a, b in zip(outs[0], outs[1]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
 def test_sketch_exact_regime_matches_uncompressed(mesh):
     # k = D and exact decode -> sketched round == uncompressed round
     _, x, y = make_problem()
