@@ -6,6 +6,12 @@ Semantics parity:
   * upload bytes per participating client per round: 4 bytes x
     mode-dependent float count (reference :291-299) — grad_size for
     uncompressed/true_topk/fedavg, k for local_topk, r*c for sketch.
+    The local_topk count stays the ANALYTIC k, exactly like the
+    reference's; above ops/flat.py's TOPK_THRESHOLD_MIN_D the actual
+    transmitted support is k within ~1% sampling noise, so the
+    analytic number remains honest to that tolerance (download bytes
+    are unaffected — they count actual changed weights via the
+    bitset).
   * download bytes per participating client: 4 bytes x number of
     weights that changed since that client last participated
     (reference :239-289), with the same cheap path (single
