@@ -69,7 +69,7 @@ EOF
 # timeout here instead.
 job_check() { # name -> echoes "tpu" when the job's artifact is a TPU run
     case "$1" in
-        headline|gpt2|local_topk|profile|imagenet|scanprof)
+        headline|gpt2|local_topk|profile|imagenet|scanprof|gpt2_long)
             log_platform "$out/$1.log" ;;
         convergence_full)
             [ "$(file_platform benchmarks/convergence_full_results.json \
@@ -95,6 +95,10 @@ job_cmd() { # name -> runs the job (stdout+stderr to its log)
                   python benchmarks/scanprof.py ;;
         headline) timeout 3600 python bench.py ;;
         gpt2) timeout 3600 python benchmarks/bench_gpt2.py ;;
+        # long-context variant: L=512 routes attention through the
+        # Pallas flash kernel (ops/attention.py, FLASH_ATTENTION_MIN_LEN)
+        gpt2_long) GPT2_BENCH_SEQ=512 GPT2_BENCH_BATCH=2 \
+                   timeout 3600 python benchmarks/bench_gpt2.py ;;
         local_topk) timeout 3600 python benchmarks/bench_local_topk.py ;;
         profile) timeout 3600 python benchmarks/profile_round.py ;;
         imagenet) timeout 3600 python benchmarks/bench_imagenet.py ;;
@@ -107,7 +111,7 @@ job_cmd() { # name -> runs the job (stdout+stderr to its log)
 }
 
 # quick deliverables first, long in-process convergence runs last
-JOBS="gpt2 local_topk scanprof headline profile imagenet config3 convergence_full gpt2_full real_format"
+JOBS="gpt2 local_topk scanprof headline profile imagenet gpt2_long config3 convergence_full gpt2_full real_format"
 
 while :; do
     pending=""
