@@ -61,11 +61,29 @@ def _try_load_cifar_pickles(root: str, name: str):
     return (to_nhwc(train[0]), train[1]), (to_nhwc(test[0]), test[1])
 
 
+# bump when the generator's semantics change: the on-disk .npy cache
+# is keyed by example counts only, so a semantic change must force a
+# re-prepare (see _cached_stats_ok)
+_SYNTH_VERSION = 2
+
+
 def _synthetic_cifar(num_classes: int, n_train: int, n_val: int, seed: int):
     """Deterministic class-separable images: per-class mean pattern +
-    noise. Gives smoke/bench runs a learnable signal."""
+    noise. Gives smoke/bench runs a learnable signal.
+
+    v2: the class protos are LOW-FREQUENCY (8x8 blocks upsampled to
+    32x32) and horizontally symmetric. v1 used i.i.d. per-pixel
+    protos, which the standard train transforms destroy: a +-4px
+    random crop decorrelates a per-pixel pattern almost entirely and
+    a horizontal flip negates it, so even direct SGD sat at chance
+    for epochs (measured — PERF.md round 5 / benchmarks/c3_probe.py).
+    Blocky symmetric protos survive crop (75%+ block overlap) and
+    flip (exactly invariant), making the augmented synthetic task
+    behave like real CIFAR instead of an adversarial one."""
     rng = np.random.RandomState(seed)
-    protos = rng.rand(num_classes, 32, 32, 3).astype(np.float32)
+    base = rng.rand(num_classes, 8, 8, 3).astype(np.float32)
+    base = (base + base[:, :, ::-1]) / 2            # flip-invariant
+    protos = np.repeat(np.repeat(base, 4, axis=1), 4, axis=2)
 
     def gen(n):
         labels = rng.randint(0, num_classes, size=n)
@@ -93,24 +111,32 @@ class FedCIFAR10(FedDataset):
         return os.path.join(self.dataset_dir, self.dataset_name)
 
     def _cached_stats_ok(self) -> bool:
-        """Re-prepare when the cached corpus isn't the one asked for:
-        a synthetic request must match the cached example counts
-        (real pickle archives on disk always win — prepare() prefers
-        them, so any cache derived from them is current)."""
-        if self._synthetic_examples is None:
-            return True
-        if _try_load_cifar_pickles(self.dataset_dir,
-                                   self.dataset_name) is not None:
-            return True
+        """Re-prepare when the cached corpus isn't the one that would
+        be prepared NOW: real pickle archives on disk always win (so a
+        cache stamped source=synthetic is stale the moment pickles
+        appear), and a synthetic cache must match both the requested
+        sizing and the current generator version."""
         try:
             import json
             with open(self.stats_path()) as f:
                 stats = json.load(f)
         except Exception:
             return False
+        have_pickles = _try_load_cifar_pickles(
+            self.dataset_dir, self.dataset_name) is not None
+        if have_pickles:
+            return stats.get("source") == "pickles"
+        if self._synthetic_examples is None:
+            # no pickles and nothing to generate: let prepare() raise
+            # its actionable FileNotFoundError only if the cache is
+            # absent; an existing cache (whatever its source) is all
+            # there is
+            return True
         n_train, n_val = self._synthetic_examples
-        return (sum(stats["images_per_client"]) == n_train
-                and stats["num_val_images"] == n_val)
+        return (stats.get("source") == "synthetic"
+                and sum(stats["images_per_client"]) == n_train
+                and stats["num_val_images"] == n_val
+                and stats.get("synthetic_version") == _SYNTH_VERSION)
 
     def prepare(self, download: bool = False):
         loaded = _try_load_cifar_pickles(self.dataset_dir,
@@ -136,7 +162,15 @@ class FedCIFAR10(FedDataset):
             images_per_client.append(int(sel.sum()))
         np.savez(os.path.join(self._dir(), "val.npz"),
                  images=xva, labels=yva)
-        self.write_stats(images_per_client, len(yva))
+        # the source + generator-version stamp is what
+        # _cached_stats_ok uses to invalidate a cache that is stale
+        # (v1 corpus) or of the wrong provenance (synthetic .npy left
+        # behind after real pickles appeared)
+        self.write_stats(
+            images_per_client, len(yva),
+            extra=({"source": "pickles"} if loaded is not None else
+                   {"source": "synthetic",
+                    "synthetic_version": _SYNTH_VERSION}))
 
     def _client_images(self, cid: int) -> np.ndarray:
         if cid not in self._cache:

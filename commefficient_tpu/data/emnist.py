@@ -50,6 +50,11 @@ def read_leaf_dir(data_dir: str):
     return users
 
 
+# bump when _synthetic_emnist's semantics change: the on-disk cache is
+# keyed by sizing + this stamp (see _cached_stats_ok)
+_SYNTH_VERSION = 1
+
+
 def _synthetic_emnist(num_writers: int, per_writer: int, n_val: int,
                       seed: int):
     """Writer-heterogeneous synthetic handwriting: class templates +
@@ -90,22 +95,27 @@ class FedEMNIST(FedDataset):
         return os.path.join(self._dir(), f"{split}.npz")
 
     def _cached_stats_ok(self) -> bool:
-        """Re-prepare when the cached corpus isn't the sizing asked
-        for (see FedDataset._cached_stats_ok); real LEAF shards on
-        disk always win."""
-        if self._synthetic_examples is None:
-            return True
-        if os.path.isdir(os.path.join(self._dir(), "raw", "train")):
-            return True
+        """Re-prepare when the cached corpus isn't the one that would
+        be prepared NOW (same contract as FedCIFAR10._cached_stats_ok:
+        real LEAF shards on disk always win, so a synthetic-stamped
+        cache is stale once they appear; a synthetic cache must match
+        the requested sizing and generator version)."""
         try:
             import json
             with open(self.stats_path()) as f:
                 stats = json.load(f)
         except Exception:
             return False
+        if os.path.isdir(os.path.join(self._dir(), "raw", "train")):
+            return stats.get("source") == "leaf"
+        if self._synthetic_examples is None:
+            return True
         writers, per_writer = self._synthetic_examples
         ipc = stats["images_per_client"]
-        return len(ipc) == writers and all(n == per_writer for n in ipc)
+        return (stats.get("source") == "synthetic"
+                and stats.get("synthetic_version") == _SYNTH_VERSION
+                and len(ipc) == writers
+                and all(n == per_writer for n in ipc))
 
     def prepare(self, download: bool = False):
         raw_train = os.path.join(self._dir(), "raw", "train")
@@ -139,7 +149,12 @@ class FedEMNIST(FedDataset):
         np.savez(self._npz_path("train"), images=images, targets=targets,
                  offsets=offsets)
         np.savez(self._npz_path("val"), images=vx, labels=vy)
-        self.write_stats([len(y) for _, y in train], len(vy))
+        from_leaf = os.path.isdir(raw_train)
+        self.write_stats(
+            [len(y) for _, y in train], len(vy),
+            extra=({"source": "leaf"} if from_leaf else
+                   {"source": "synthetic",
+                    "synthetic_version": _SYNTH_VERSION}))
 
     def _load(self, split: str):
         if split not in self._z:

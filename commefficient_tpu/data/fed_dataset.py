@@ -64,11 +64,19 @@ class FedDataset:
                             "stats.json")
 
     def write_stats(self, images_per_client: Sequence[int],
-                    num_val_images: int):
+                    num_val_images: int, extra: Optional[dict] = None):
+        """`extra`: dataset-specific metadata written alongside the
+        counts in one shot — e.g. the synthetic-generator version and
+        corpus source that _cached_stats_ok implementations use to
+        invalidate stale caches (a semantic change to a generator
+        must not silently serve the pre-change corpus)."""
         os.makedirs(os.path.dirname(self.stats_path()), exist_ok=True)
+        stats = {"images_per_client": [int(x) for x in images_per_client],
+                 "num_val_images": int(num_val_images)}
+        if extra:
+            stats.update(extra)
         with open(self.stats_path(), "w") as f:
-            json.dump({"images_per_client": [int(x) for x in images_per_client],
-                       "num_val_images": int(num_val_images)}, f)
+            json.dump(stats, f)
 
     def _load_meta(self):
         with open(self.stats_path()) as f:

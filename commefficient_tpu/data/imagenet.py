@@ -26,6 +26,10 @@ from commefficient_tpu.data.fed_dataset import FedDataset
 
 NUM_CLASSES = 1000
 
+# bump when _generate_synthetic's semantics change: the on-disk cache
+# is keyed by geometry + this stamp (see _cached_stats_ok)
+_SYNTH_VERSION = 1
+
 
 class FedImageNet(FedDataset):
     num_classes = NUM_CLASSES
@@ -70,7 +74,10 @@ class FedImageNet(FedDataset):
         n_cls = min(NUM_CLASSES, 16)
         per = max(n_train // n_cls, 1)
         ipc = stats["images_per_client"]
-        return (len(ipc) == n_cls and all(n == per for n in ipc)
+        return (stats.get("source", "synthetic") == "synthetic"
+                and stats.get("synthetic_version",
+                              _SYNTH_VERSION) == _SYNTH_VERSION
+                and len(ipc) == n_cls and all(n == per for n in ipc)
                 and stats["num_val_images"] == n_val)
 
     # ---- indexing -------------------------------------------------------
@@ -90,7 +97,8 @@ class FedImageNet(FedDataset):
                 counts.append(len(np.load(p, mmap_mode="r")))
             n_val = len(np.load(self._pre("val.npz"))["labels"]) \
                 if os.path.exists(self._pre("val.npz")) else 0
-            self.write_stats(counts, n_val)
+            self.write_stats(counts, n_val,
+                             extra={"source": "preprocessed"})
         elif os.path.isdir(raw):
             wnids = sorted(os.listdir(raw))
             counts = [len(os.listdir(os.path.join(raw, w))) for w in wnids]
@@ -98,7 +106,7 @@ class FedImageNet(FedDataset):
             n_val = (sum(len(os.listdir(os.path.join(val_dir, w)))
                          for w in os.listdir(val_dir))
                      if os.path.isdir(val_dir) else 0)
-            self.write_stats(counts, n_val)
+            self.write_stats(counts, n_val, extra={"source": "raw"})
         elif self._synthetic_examples is not None:
             n_train, n_val = self._synthetic_examples
             self._generate_synthetic(n_train, n_val)
@@ -126,7 +134,9 @@ class FedImageNet(FedDataset):
         xv = np.clip(templates[yv] + rng.randn(n_val, hw, hw, 3) * 0.1, 0, 1)
         np.savez(self._pre("val.npz"), images=(xv * 255).astype(np.uint8),
                  labels=yv)
-        self.write_stats(counts, n_val)
+        self.write_stats(counts, n_val,
+                         extra={"source": "synthetic",
+                                "synthetic_version": _SYNTH_VERSION})
 
     # ---- fetch ----------------------------------------------------------
     def _raw_class_images(self, cid: int) -> np.ndarray:

@@ -28,6 +28,52 @@ def test_natural_partition_one_class_per_client(cifar):
     assert np.all(labels == 3)
 
 
+def test_synthetic_cache_invalidated_when_pickles_appear(tmp_path):
+    # a cache generated synthetically must NOT be served once real
+    # pickle archives land in the dataset dir (the stats.json source
+    # stamp drives the re-prepare)
+    import json
+    import pickle
+    ds = FedCIFAR10(str(tmp_path), synthetic_examples=(100, 20))
+    with open(ds.stats_path()) as f:
+        assert json.load(f)["source"] == "synthetic"
+
+    rng = np.random.RandomState(0)
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    for name, n in [(f"data_batch_{i}", 10) for i in range(1, 6)] + [
+            ("test_batch", 10)]:
+        with open(d / name, "wb") as f:
+            pickle.dump({b"data": rng.randint(
+                0, 255, (n, 3072), dtype=np.uint8),
+                b"labels": list(rng.randint(0, 10, n))}, f)
+
+    ds2 = FedCIFAR10(str(tmp_path), synthetic_examples=(100, 20))
+    with open(ds2.stats_path()) as f:
+        stats = json.load(f)
+    assert stats["source"] == "pickles"
+    assert sum(stats["images_per_client"]) == 50  # the real corpus
+
+
+def test_synthetic_cache_invalidated_on_generator_version(tmp_path):
+    import json
+    ds = FedCIFAR10(str(tmp_path), synthetic_examples=(100, 20))
+    first = ds.get_client_batch(0, np.arange(2))[0]
+    # simulate a stale-generator cache: wind the stamp back
+    with open(ds.stats_path()) as f:
+        stats = json.load(f)
+    stats["synthetic_version"] = 1
+    with open(ds.stats_path(), "w") as f:
+        json.dump(stats, f)
+    ds2 = FedCIFAR10(str(tmp_path), synthetic_examples=(100, 20))
+    with open(ds2.stats_path()) as f:
+        assert (json.load(f)["synthetic_version"]
+                == __import__("commefficient_tpu.data.cifar",
+                              fromlist=["x"])._SYNTH_VERSION)
+    np.testing.assert_array_equal(
+        first, ds2.get_client_batch(0, np.arange(2))[0])
+
+
 def test_resharding_num_clients(tmp_path):
     ds = FedCIFAR10(str(tmp_path), num_clients=20,
                     synthetic_examples=(500, 100))
