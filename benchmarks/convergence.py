@@ -78,8 +78,14 @@ def make_data(seed=0, num_clients=10):
     # old 8192x(16,32,32,32)-channel config made even a 2-epoch smoke
     # take an hour; 1024 examples x batch 8 x the narrower net below
     # is ~1 s/round and still converges on the class-prototype corpus
+    # signal=0.45: the default 0.6 v2 corpus is so learnable that
+    # every mode saturates at 1.0 and the suite's claims (fedavg
+    # starvation lift, down_k truncation cost) lose their
+    # discriminative power — a ceiling, not a finding. 0.45 keeps the
+    # augmented task solvable but leaves headroom for the compression
+    # modes to differ.
     common = dict(transform=None, do_iid=True, num_clients=num_clients,
-                  seed=seed,
+                  seed=seed, synthetic_signal=0.45,
                   synthetic_examples=(n_train, n_train // 4))
     train = FedCIFAR10(root, transform=train_t, train=True,
                        **{k: v for k, v in common.items()
@@ -307,6 +313,21 @@ def main():
         "local_topk_upload_compression_x": round(lt_ratio, 2),
         "max_seed_spread": max(r["final_acc_spread"] for r in runs),
     }
+
+    def spread(m):
+        return by_mode[m]["final_acc_spread"]
+
+    # whether the round-starvation claim can be demanded at all at
+    # this corpus difficulty (see the assertion block below); recorded
+    # in the artifact so a saturated suite is visibly degenerate. The
+    # gap is a difference of two noisy means: widen the gate by BOTH
+    # spreads so a lucky uncompressed seed can't flakily demand the
+    # strict lift.
+    starved_gap = (results["summary"]["uncompressed_final_acc"]
+                   - results["summary"]["fedavg_final_acc"])
+    claim_exercised = (starved_gap
+                       > 0.12 + spread("fedavg") + spread("uncompressed"))
+    results["summary"]["starvation_claim_exercised"] = claim_exercised
     import bench
     with open(bench.artifact_dest(
             OUT, results["config"]["platform"]), "w") as f:
@@ -317,10 +338,8 @@ def main():
     # are seed-noise-aware: at this corpus size a single seed swings
     # several points (measured sketch spread 0.059 over seeds 0-2), so
     # fixed margins tuned on one seed produce flaky claims — each
-    # behind-by margin widens by the claimant's own measured spread.
-    def spread(m):
-        return by_mode[m]["final_acc_spread"]
-
+    # behind-by margin widens by the claimant's own measured spread
+    # (`spread`, defined with the summary above).
     assert acc("sketch") > 0.5, "sketched training failed to learn"
     assert acc("sketch") > acc("uncompressed") - 0.05 - spread("sketch"), \
         "sketch fell behind uncompressed beyond a few points + seed noise"
@@ -332,10 +351,25 @@ def main():
     # fedavg trains ~16x fewer aggregation rounds than the per-batch
     # modes at this corpus (see sweep note above); 4 local epochs at
     # the same round count must recover most of the uncompressed gap —
-    # the round-starvation explanation, asserted
-    assert acc("fedavg_e4") > acc("fedavg") + 0.1, \
-        "more local epochs failed to lift fedavg (round-starvation " \
-        "explanation would be wrong -> investigate as a bug)"
+    # the round-starvation explanation, asserted. CEILING-AWARE: the
+    # lift can only be demanded when starvation actually cost
+    # something at this corpus difficulty — on a corpus easy enough
+    # that 12 starved rounds already match uncompressed, e4 must
+    # merely not regress.
+    if claim_exercised:
+        assert acc("fedavg_e4") > acc("fedavg") + 0.1, \
+            "more local epochs failed to lift fedavg (round-" \
+            "starvation explanation would be wrong -> investigate)"
+    else:
+        # corpus too easy for starvation to bind — keep the degeneracy
+        # LOUD so a saturated suite is never mistaken for evidence
+        print(f"WARNING: starvation claim NOT exercised (gap "
+              f"{starved_gap:.3f} within noise) — corpus difficulty "
+              f"leaves no headroom; lower synthetic_signal",
+              flush=True)
+        assert acc("fedavg_e4") >= acc("fedavg") - 0.05 \
+            - spread("fedavg_e4"), \
+            "fedavg_e4 regressed below starved fedavg"
     assert acc("fedavg_e4") > acc("uncompressed") - 0.15, \
         "fedavg_e4 still far behind uncompressed"
     # topk_down trains on truncated stale weights; the paper reports

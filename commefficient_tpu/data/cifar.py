@@ -67,7 +67,8 @@ def _try_load_cifar_pickles(root: str, name: str):
 _SYNTH_VERSION = 2
 
 
-def _synthetic_cifar(num_classes: int, n_train: int, n_val: int, seed: int):
+def _synthetic_cifar(num_classes: int, n_train: int, n_val: int, seed: int,
+                     signal: float = 0.6):
     """Deterministic class-separable images: per-class mean pattern +
     noise. Gives smoke/bench runs a learnable signal.
 
@@ -79,7 +80,12 @@ def _synthetic_cifar(num_classes: int, n_train: int, n_val: int, seed: int):
     for epochs (measured — PERF.md round 5 / benchmarks/c3_probe.py).
     Blocky symmetric protos survive crop (75%+ block overlap) and
     flip (exactly invariant), making the augmented synthetic task
-    behave like real CIFAR instead of an adversarial one."""
+    behave like real CIFAR instead of an adversarial one.
+
+    `signal` is the proto mixing weight (1-signal is noise): 0.6 makes
+    an easy corpus for smokes/benches; convergence studies that need
+    the compression modes to DIFFERENTIATE (not all saturate at 1.0)
+    pass a lower value."""
     rng = np.random.RandomState(seed)
     base = rng.rand(num_classes, 8, 8, 3).astype(np.float32)
     base = (base + base[:, :, ::-1]) / 2            # flip-invariant
@@ -88,7 +94,7 @@ def _synthetic_cifar(num_classes: int, n_train: int, n_val: int, seed: int):
     def gen(n):
         labels = rng.randint(0, num_classes, size=n)
         noise = rng.rand(n, 32, 32, 3).astype(np.float32)
-        imgs = 0.6 * protos[labels] + 0.4 * noise
+        imgs = signal * protos[labels] + (1.0 - signal) * noise
         return (imgs * 255).astype(np.uint8), labels.astype(np.int64)
 
     return gen(n_train), gen(n_val)
@@ -100,8 +106,9 @@ class FedCIFAR10(FedDataset):
     def __init__(self, dataset_dir, dataset_name="CIFAR10", transform=None,
                  do_iid=False, num_clients=None, train=True, download=False,
                  synthetic_examples: Optional[Tuple[int, int]] = None,
-                 seed: int = 0):
+                 seed: int = 0, synthetic_signal: float = 0.6):
         self._synthetic_examples = synthetic_examples
+        self._synthetic_signal = synthetic_signal
         self._seed = seed
         super().__init__(dataset_dir, dataset_name, transform, do_iid,
                          num_clients, train, download, seed)
@@ -136,7 +143,8 @@ class FedCIFAR10(FedDataset):
         return (stats.get("source") == "synthetic"
                 and sum(stats["images_per_client"]) == n_train
                 and stats["num_val_images"] == n_val
-                and stats.get("synthetic_version") == _SYNTH_VERSION)
+                and stats.get("synthetic_version") == _SYNTH_VERSION
+                and stats.get("synthetic_signal") == self._synthetic_signal)
 
     def prepare(self, download: bool = False):
         loaded = _try_load_cifar_pickles(self.dataset_dir,
@@ -150,7 +158,8 @@ class FedCIFAR10(FedDataset):
                     f"synthetic data")
             n_train, n_val = self._synthetic_examples
             (xtr, ytr), (xva, yva) = _synthetic_cifar(
-                self.num_classes, n_train, n_val, self._seed)
+                self.num_classes, n_train, n_val, self._seed,
+                signal=self._synthetic_signal)
         else:
             (xtr, ytr), (xva, yva) = loaded
 
@@ -170,7 +179,8 @@ class FedCIFAR10(FedDataset):
             images_per_client, len(yva),
             extra=({"source": "pickles"} if loaded is not None else
                    {"source": "synthetic",
-                    "synthetic_version": _SYNTH_VERSION}))
+                    "synthetic_version": _SYNTH_VERSION,
+                    "synthetic_signal": self._synthetic_signal}))
 
     def _client_images(self, cid: int) -> np.ndarray:
         if cid not in self._cache:
