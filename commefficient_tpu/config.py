@@ -97,6 +97,23 @@ class Config:
     lr_scale: Optional[float] = None
     pivot_epoch: float = 5.0
 
+    # fault tolerance (an extension beyond the reference, which assumes
+    # every sampled client finishes every round and every run finishes
+    # uninterrupted — neither holds in FetchSGD's target setting or on
+    # preemptible TPU pods). client_dropout is the per-round Bernoulli
+    # probability that a sampled client FAILS to complete the round:
+    # its upload is excluded from aggregation (survivor-count
+    # reweighting), its persistent error/velocity/stale-weight rows
+    # stay bit-untouched, and accounting charges it nothing. The draw
+    # is deterministic in (seed, round), so crash->resume replays it
+    # exactly. 0.0 keeps the engine on the mask-free program — the
+    # machinery costs nothing when disabled. Tests inject explicit
+    # per-round schedules instead (utils/faults.FaultSchedule).
+    client_dropout: float = 0.0
+    # keep the newest k rotated mid-run checkpoints (utils/checkpoint.
+    # save_rotating); older ones are pruned after each atomic save
+    keep_checkpoints: int = 3
+
     # parallelization (utils.py:165-180). `port` kept for CLI parity but
     # unused: there is no process-group rendezvous in a single-program
     # SPMD runtime (reference needed it at fed_aggregator.py:161-164).
@@ -288,6 +305,12 @@ class Config:
                 "uncompressed cannot use local error accumulation "
                 "(reference asserts this at fed_worker.py:221-222)"
             )
+        if not 0.0 <= self.client_dropout < 1.0:
+            raise ValueError(
+                f"client_dropout={self.client_dropout} must be in [0, 1) "
+                "(1.0 would drop every client every round)")
+        if self.keep_checkpoints < 1:
+            raise ValueError("keep_checkpoints must be >= 1")
         if self.down_k < 0:
             raise ValueError("down_k must be >= 0 (0 = share the upload k)")
         if self.down_k > self.grad_size > 0:
@@ -341,6 +364,14 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--error_type", choices=list(ERROR_TYPES), default="none")
     p.add_argument("--lr_scale", type=float, default=default_lr)
     p.add_argument("--pivot_epoch", type=float, default=5)
+
+    p.add_argument("--client_dropout", type=float, default=0.0,
+                   help="per-round probability a sampled client fails "
+                        "to complete the round (survivor-reweighted "
+                        "aggregation; Config.client_dropout)")
+    p.add_argument("--keep_checkpoints", type=int, default=3,
+                   help="keep the newest k rotated mid-run checkpoints "
+                        "(utils/checkpoint.save_rotating)")
 
     p.add_argument("--port", type=int, default=5315)
     p.add_argument("--num_clients", type=int)

@@ -134,22 +134,37 @@ class CommAccountant:
         if self.cheap:
             self.updated_since_init = np.zeros(self.n_words, np.uint32)
         else:
-            participation = cfg.num_workers / num_clients
+            # expected gap between a client's COMPLETED rounds is
+            # 1 / (sampling rate * survival rate): client dropout
+            # lengthens absences, and an overflowed window would make
+            # the stale clip below silently undercharge the
+            # accumulated download a returning client owes
+            participation = (cfg.num_workers / num_clients
+                             * (1.0 - cfg.client_dropout))
             maxlen = int(DEQUE_MAXLEN_MULT / participation)
             self.changes: deque = deque([], maxlen=maxlen)
             self.stale = np.zeros(num_clients, np.int64)
 
     def record_round(self, participating: np.ndarray,
-                     prev_changed_words: Optional[np.ndarray]):
+                     prev_changed_words: Optional[np.ndarray],
+                     survivors: Optional[np.ndarray] = None):
         """Account one round. `prev_changed_words` is the packed change
         bitset of the PREVIOUS round's weight update (None on the first
         round — weights haven't changed since clients were initialized,
         so round 1 downloads are free, matching reference :258-261).
 
+        `survivors`: optional [W] {0,1} mask aligned with
+        `participating` (client dropout). A dropped client completed
+        neither its download nor its upload, so it is charged NOTHING
+        and its staleness counter keeps growing — it will pay the
+        accumulated download the next round it actually finishes.
+
         Returns (download_bytes, upload_bytes), each [num_clients].
         """
         download = np.zeros(self.num_clients)
         participating = np.asarray(participating)
+        if survivors is not None:
+            participating = participating[np.asarray(survivors) > 0]
 
         if self.cheap:
             if prev_changed_words is not None:
@@ -174,14 +189,18 @@ class CommAccountant:
         return download, upload
 
     def advance_round(self, participating: np.ndarray,
-                      prev_changed_words: Optional[np.ndarray]) -> None:
+                      prev_changed_words: Optional[np.ndarray],
+                      survivors: Optional[np.ndarray] = None) -> None:
         """Advance the accountant's state for a round whose byte totals
         the caller doesn't want (FedModel.run_rounds(account=False)):
         the change deque and staleness counters move exactly as in
-        record_round, only the popcount work is skipped. Without this,
-        the first accounted round after an unaccounted span would
-        misattribute download bytes."""
+        record_round (dropped clients' staleness included), only the
+        popcount work is skipped. Without this, the first accounted
+        round after an unaccounted span would misattribute download
+        bytes."""
         participating = np.asarray(participating)
+        if survivors is not None:
+            participating = participating[np.asarray(survivors) > 0]
         if self.cheap:
             if prev_changed_words is not None:
                 self.updated_since_init |= np.asarray(prev_changed_words)
@@ -210,6 +229,15 @@ class CommAccountant:
                 state["updated_since_init"], np.uint32)
         else:
             self.stale = np.asarray(state["stale"], np.int64)
+            rows = np.asarray(state["changes"], np.uint32)
+            if self.changes.maxlen is not None and \
+                    len(rows) > self.changes.maxlen:
+                # the checkpoint was written under a config with a
+                # wider window (e.g. a higher client_dropout, which
+                # isn't — deliberately — in the fingerprint): grow to
+                # fit rather than silently dropping the oldest rows,
+                # which would undercharge returning clients' downloads
+                self.changes = deque([], maxlen=len(rows))
             self.changes.clear()
-            for row in np.asarray(state["changes"], np.uint32):
+            for row in rows:
                 self.changes.append(row)

@@ -46,6 +46,9 @@ from commefficient_tpu.federated.accounting import (
 from commefficient_tpu.ops.flat import flatten_params
 from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
+from commefficient_tpu.utils.faults import (
+    FaultSchedule, InjectedFault, bernoulli_survivors,
+)
 
 
 class FedModel:
@@ -160,6 +163,49 @@ class FedModel:
         # globalize's callback — correct for any device->process
         # layout, at the cost of host-materializing the full batch.
         self.feed_global = False
+        # fault tolerance: host-side mirror of server.round_idx (kept
+        # in lockstep so survivor draws and crash points never sync on
+        # the device counter), plus an optional injected fault script
+        # (utils/faults.FaultSchedule; set_fault_schedule)
+        self._rounds_done = 0
+        self.fault_schedule: Optional[FaultSchedule] = None
+
+    def set_fault_schedule(self,
+                           schedule: Optional[FaultSchedule]) -> None:
+        """Install (or clear, with None) a deterministic fault script:
+        scripted client drops override/augment the random
+        client_dropout draw, and crash_after raises InjectedFault once
+        that round has fully completed — the preemption point a
+        checkpoint/resume test (or chaos drill) recovers from."""
+        self.fault_schedule = schedule
+
+    @property
+    def checkpoint_fingerprint(self) -> dict:
+        """The config-compatibility fingerprint checkpoints written by
+        this model embed, and resumes into it must match."""
+        from commefficient_tpu.utils.checkpoint import config_fingerprint
+        return config_fingerprint(self.cfg, self.num_clients)
+
+    def _survivors_for_round(self, round_idx: int, client_ids
+                             ) -> Optional[np.ndarray]:
+        """[W] f32 survivor mask for one round, or None when nothing
+        drops clients (the mask-free fast path — None keeps the jitted
+        round on the exact program a dropout-free build traces).
+        Deterministic in (cfg.seed, round_idx), so crash->resume
+        replays the identical masks. Host-side by design: the mask
+        enters the jitted round as data AND drives byte accounting
+        without any device sync."""
+        ids = np.asarray(client_ids)
+        mask = None
+        if self.cfg.client_dropout > 0:
+            mask = bernoulli_survivors(self.cfg.seed, round_idx,
+                                       ids.shape[0],
+                                       self.cfg.client_dropout)
+        if self.fault_schedule is not None:
+            scripted = self.fault_schedule.survival_mask(round_idx, ids)
+            if scripted is not None:
+                mask = scripted if mask is None else mask * scripted
+        return mask
 
     # -- reference API surface -------------------------------------------
     def train(self, training: bool):
@@ -189,7 +235,19 @@ class FedModel:
         model, globalizing every field onto this model's mesh — the
         multi-controller-safe resume path (every process loads the same
         file from shared storage, the reference's rank-0 rendezvous
-        inverted). Returns the checkpoint's scheduler step."""
+        inverted). Returns the checkpoint's scheduler step.
+
+        Validates the checkpoint's config fingerprint (when present)
+        against this model — a mismatched resume raises
+        CheckpointMismatchError here even if the caller skipped
+        validation at load_checkpoint time."""
+        if ckpt.fingerprint is not None:
+            from commefficient_tpu.utils.checkpoint import (
+                validate_fingerprint,
+            )
+            validate_fingerprint(ckpt.fingerprint,
+                                 self.checkpoint_fingerprint,
+                                 "<loaded checkpoint>")
         P = self._P
         s = ckpt.server
         self.server = fround.ServerState(
@@ -208,6 +266,9 @@ class FedModel:
             self.accountant.load_state_dict(ckpt.accountant_state)
         if ckpt.prev_change_words is not None:
             self._prev_change_words = ckpt.prev_change_words
+        # resync the host round mirror so dropout draws / crash points
+        # continue exactly where the checkpointed run left off
+        self._rounds_done = int(np.asarray(ckpt.server.round_idx))
         return ckpt.scheduler_step
 
     # -- internals --------------------------------------------------------
@@ -245,6 +306,9 @@ class FedModel:
         client_ids, data, mask = batch
         prev_weights = self.server.ps_weights
 
+        this_round = self._rounds_done
+        survivors = self._survivors_for_round(this_round, client_ids)
+
         P = self._P
         lr = self._lr()
         if isinstance(lr, np.ndarray):
@@ -255,8 +319,11 @@ class FedModel:
                 mh.globalize(self.mesh, P(),
                              np.asarray(client_ids, np.int32)),
                 tuple(self._feed(d) for d in data),
-                self._feed(mask)),
+                self._feed(mask),
+                None if survivors is None
+                else mh.globalize(self.mesh, P(), survivors)),
             lr, self._key)
+        self._rounds_done = this_round + 1
 
         # Communication accounting with ONE round of lag: this round's
         # change bitset is dispatched and its device->host copy started
@@ -270,8 +337,16 @@ class FedModel:
         download, upload = self.accountant.record_round(
             np.asarray(client_ids),
             None if self._prev_change_words is None
-            else np.asarray(self._prev_change_words))
+            else np.asarray(self._prev_change_words),
+            survivors=survivors)
         self._prev_change_words = bits
+
+        # injected preemption: the round above fully completed (state,
+        # accounting, round counter) — crash at the exact boundary a
+        # real preemption would leave behind
+        if (self.fault_schedule is not None
+                and self.fault_schedule.should_crash(this_round)):
+            raise InjectedFault(this_round)
 
         # metrics stay device arrays: callers that float() them decide
         # when to pay the sync (drivers materialize with a 1-round lag)
@@ -286,8 +361,46 @@ class FedModel:
         with download/upload summed over the span. account=False
         returns zeros and skips the per-round popcount work, but the
         [N, D/32] bitset transfer and staleness bookkeeping still
-        happen so later accounted rounds stay correct."""
+        happen so later accounted rounds stay correct.
+
+        Fault tolerance: per-round survivor masks (client_dropout /
+        FaultSchedule drops) ride into the scanned program as a
+        [N, W] operand; a FaultSchedule crash_after that lands INSIDE
+        the span truncates it — only the rounds up to and including
+        the crash round run (and are accounted), then InjectedFault is
+        raised at the identical boundary the unscanned path crashes
+        at, so scanned and per-round runs checkpoint/resume
+        bit-identically."""
         lrs = np.asarray(lrs, np.float32)
+        ids_host = np.asarray(client_ids)
+        n_rounds = ids_host.shape[0]
+        first = self._rounds_done
+
+        # span truncation at an injected crash boundary
+        crash_at = None
+        if (self.fault_schedule is not None
+                and self.fault_schedule.crash_after is not None
+                and first <= self.fault_schedule.crash_after
+                < first + n_rounds):
+            crash_at = int(self.fault_schedule.crash_after)
+            n_rounds = crash_at - first + 1
+            ids_host = ids_host[:n_rounds]
+            lrs = lrs[:n_rounds]
+            data = tuple(np.asarray(d)[:n_rounds] for d in data)
+            mask = np.asarray(mask)[:n_rounds]
+
+        # per-round survivor masks (None when nothing can drop — the
+        # mask-free treedef keeps the dropout-free scanned program)
+        surv_all = None
+        if self.cfg.client_dropout > 0 or self.fault_schedule is not None:
+            rows = [self._survivors_for_round(first + n, ids_host[n])
+                    for n in range(n_rounds)]
+            if any(r is not None for r in rows):
+                surv_all = np.stack(
+                    [r if r is not None
+                     else np.ones(ids_host.shape[1], np.float32)
+                     for r in rows])
+
         if self.lr_scale_vec is not None:
             # per-parameter LR scaling — same routing _lr() applies on
             # the single-round path (incl. fedavg: the vector reaches
@@ -302,24 +415,28 @@ class FedModel:
                 self.server, self.clients,
                 fround.RoundBatch(
                     mh.globalize(self.mesh, P(),
-                                 np.asarray(client_ids, np.int32)),
+                                 np.asarray(ids_host, np.int32)),
                     tuple(self._feed(d, leading_axes=1)
                           for d in data),
-                    self._feed(mask, leading_axes=1)),
+                    self._feed(mask, leading_axes=1),
+                    None if surv_all is None
+                    else mh.globalize(self.mesh, P(), surv_all)),
                 mh.globalize(self.mesh, P(), lrs), self._key))
+        self._rounds_done = first + n_rounds
 
         download = np.zeros(self.num_clients)
         upload = np.zeros(self.num_clients)
         bits_host = np.asarray(bits)
-        ids_host = np.asarray(client_ids)
         if self._prev_change_words is not None:
             # may still be a device array from a preceding single-round
             # call (the lazy-sync path in _call_train)
             self._prev_change_words = np.asarray(self._prev_change_words)
         for n in range(ids_host.shape[0]):
+            surv_n = None if surv_all is None else surv_all[n]
             if account:
                 d, u = self.accountant.record_round(
-                    ids_host[n], self._prev_change_words)
+                    ids_host[n], self._prev_change_words,
+                    survivors=surv_n)
                 download += d
                 upload += u
             else:
@@ -327,8 +444,14 @@ class FedModel:
                 # (skipping only the popcount work) so a later accounted
                 # round doesn't misattribute downloads across the gap
                 self.accountant.advance_round(
-                    ids_host[n], self._prev_change_words)
+                    ids_host[n], self._prev_change_words,
+                    survivors=surv_n)
             self._prev_change_words = bits_host[n]
+
+        if crash_at is not None:
+            # every completed round's state/accounting landed above —
+            # crash at the same boundary the unscanned path does
+            raise InjectedFault(crash_at)
 
         losses = mh.gather_host(metrics.losses)
         mets = [mh.gather_host(m) for m in metrics.metrics]

@@ -242,7 +242,8 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
 
 def fused_shard_grads(flat_loss_fn, weights, batch, mask,
                       cfg: Config,
-                      grad_mask: Optional[jax.Array] = None):
+                      grad_mask: Optional[jax.Array] = None,
+                      survivors: Optional[jax.Array] = None):
     """One backward pass for a whole shard of clients
     (Config.fused_client_backward's gate guarantees this equals the
     sum of per-client local_step transmits):
@@ -255,6 +256,13 @@ def fused_shard_grads(flat_loss_fn, weights, batch, mask,
     contributes (wd/num_workers) * w * total_count (reference
     utils.py:254-259 semantics preserved).
 
+    survivors: optional [W_shard] f32 {0,1} dropout mask. Each
+    client's term of the fused objective (and its weight-decay
+    contribution) is scaled by its survivor bit, so a dropped client
+    contributes exactly nothing to the shard gradient — the same
+    linearity that lets the fusion exist at all. Returned counts are
+    survivor-weighted; losses/metrics stay per-client diagnostics.
+
     batch/mask are the shard's [W_shard, B, ...] arrays. Returns
     (grad_sum [D], losses [W_shard], metrics, counts [W_shard]) where
     losses/metrics are per-client masked means — the same reporting
@@ -265,6 +273,8 @@ def fused_shard_grads(flat_loss_fn, weights, batch, mask,
             loss, metrics = flat_loss_fn(vec, d, m)
             return loss, metrics, m.sum()
         losses, metrics, counts = jax.vmap(one)(batch, mask)
+        if survivors is not None:
+            counts = counts * survivors
         total = (losses * counts).sum()
         return total, (losses, metrics, counts)
 

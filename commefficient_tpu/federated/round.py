@@ -31,8 +31,9 @@ from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.parallel.compat import pcast, shard_map
 
 from commefficient_tpu.config import Config
 from commefficient_tpu.federated import client as fclient
@@ -60,10 +61,22 @@ class ClientState(NamedTuple):
 
 class RoundBatch(NamedTuple):
     """One round's input: `num_workers` participating clients, each
-    with a padded local batch (static shapes; SURVEY.md §7.3 #2)."""
+    with a padded local batch (static shapes; SURVEY.md §7.3 #2).
+
+    survivors: optional [num_workers] f32 {0,1} mask — 0 marks a
+    sampled client that FAILED to complete the round (client dropout,
+    Config.client_dropout / utils.faults). Dropped clients contribute
+    nothing to the aggregate (survivor-count reweighting), their
+    persistent state rows are written back bit-untouched, and a
+    zero-survivor round leaves ps_weights/Vvelocity/Verror bit-exact
+    (only round_idx advances, so the PRNG stream moves on). None —
+    the default, and the only treedef dropout-free callers ever build
+    — traces the original mask-free program: dropout machinery is
+    free when disabled."""
     client_ids: jax.Array        # [num_workers] int32
     data: Tuple[jax.Array, ...]  # pytree of [num_workers, B, ...]
     mask: jax.Array              # [num_workers, B] f32 validity
+    survivors: Optional[jax.Array] = None  # [num_workers] f32 or None
 
 
 class RoundMetrics(NamedTuple):
@@ -198,17 +211,24 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
 
     # ---------------- per-shard client phase ----------------------------
     def shard_train(ps_weights, data, mask, err_rows, vel_rows, w_rows,
-                    keys, lr):
+                    keys, lr, surv=None):
         """Runs on one shard: simulate W = num_workers/n_shards clients
         (vmap), locally sum their compressed updates, psum across the
         clients axis (the reference's per-GPU client loop
-        fed_worker.py:60-131 + NCCL reduce :138)."""
+        fed_worker.py:60-131 + NCCL reduce :138).
+
+        surv: optional [W_shard] f32 survivor mask — a dropped client's
+        transmit and example count are zeroed BEFORE the local sum, so
+        the psum'd aggregate and its divide-by-total reweighting see
+        survivors only. Its per-client loss/metric rows are still
+        reported (simulation diagnostics), but num_examples is zeroed
+        so count-weighted consumers exclude it."""
         # Cast the replicated weights to shard-varying before any
         # jax.grad: differentiating w.r.t. an *unvarying* operand under
         # shard_map makes JAX psum the cotangent across shards (correct
         # for grad-through-shard_map, wrong here — each client needs its
         # own local gradient, not the cross-client sum).
-        ps_weights = jax.lax.pcast(ps_weights, "clients", to="varying")
+        ps_weights = pcast(ps_weights, "clients", to="varying")
 
         def one_client(cdata, cmask, err, vel, w_stale, key):
             if cfg.do_topk_down:
@@ -240,19 +260,31 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         if cfg.fused_client_backward:
             # one backward for the whole shard (gate guarantees
             # equality with the per-client path — Config property and
-            # fclient.fused_shard_grads docstrings)
+            # fclient.fused_shard_grads docstrings); survivors weight
+            # each client's term of the fused objective, so dropped
+            # clients contribute exactly nothing to the shard gradient
             local_sum, losses, metrics, counts = fclient.fused_shard_grads(
                 flat_loss, ps_weights, data, mask, cfg,
-                grad_mask=grad_mask)
+                grad_mask=grad_mask, survivors=surv)
             dummy = jnp.zeros_like(mask, shape=mask.shape[:1])
             new_err = new_vel = new_w_rows = dummy
         else:
             results, new_w_rows = jax.vmap(one_client)(
                 data, mask, err_rows, vel_rows, w_rows, keys)
-            local_sum = jax.tree.map(
-                lambda t: t.sum(axis=0), results.transmit)
-            losses, metrics, counts = (
-                results.loss, results.metrics, results.num_examples)
+            if surv is not None:
+                # zero dropped clients' uploads BEFORE the local sum —
+                # the psum'd aggregate and the divide-by-total see
+                # survivors only (survivor-count reweighting)
+                local_sum = jax.tree.map(
+                    lambda t: (t * surv.reshape(
+                        surv.shape + (1,) * (t.ndim - 1))).sum(axis=0),
+                    results.transmit)
+                counts = results.num_examples * surv
+            else:
+                local_sum = jax.tree.map(
+                    lambda t: t.sum(axis=0), results.transmit)
+                counts = results.num_examples
+            losses, metrics = results.loss, results.metrics
             new_err, new_vel = results.error, results.velocity
 
         if cfg.defer_sketch_encode:
@@ -282,6 +314,22 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         axis_names=frozenset({"clients"}),
     )
 
+    # dropout variant: same program plus a [W] survivor-mask operand,
+    # sharded like every other per-client row. Built as a SEPARATE
+    # mapped fn (rather than a ones-mask default operand) so the
+    # dropout-free treedef traces the original mask-free program —
+    # client_dropout=0.0 stays bit-identical to a build without the
+    # feature.
+    shard_train_surv_mapped = shard_map(
+        shard_train, mesh=mesh,
+        in_specs=(P(), P("clients"), P("clients"), P("clients"),
+                  P("clients"), P("clients"), P("clients"), P(),
+                  P("clients")),
+        out_specs=(P(), P(), state_spec, state_spec, state_spec,
+                   P("clients"), P("clients"), P("clients")),
+        axis_names=frozenset({"clients"}),
+    )
+
     # ---------------- full train round ----------------------------------
     def round_step(server: ServerState, clients: ClientState,
                    batch: RoundBatch, lr, key):
@@ -306,38 +354,74 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             lambda i: jax.random.fold_in(round_key, i)
         )(jnp.arange(num_workers))
 
-        (transmit, total, new_err, new_vel, new_w, losses, metrics,
-         counts) = shard_train_mapped(
-            server.ps_weights, batch.data, batch.mask,
-            err_rows, vel_rows, w_rows, client_keys, lr)
+        surv = batch.survivors
+        if surv is None:
+            (transmit, total, new_err, new_vel, new_w, losses, metrics,
+             counts) = shard_train_mapped(
+                server.ps_weights, batch.data, batch.mask,
+                err_rows, vel_rows, w_rows, client_keys, lr)
+            alive = None
+        else:
+            surv = surv.astype(jnp.float32)
+            (transmit, total, new_err, new_vel, new_w, losses, metrics,
+             counts) = shard_train_surv_mapped(
+                server.ps_weights, batch.data, batch.mask,
+                err_rows, vel_rows, w_rows, client_keys, lr, surv)
+            # zero-survivor round -> gate the whole server update off
+            # (get_server_update applies it): momentum/error state and
+            # ps_weights come through bit-untouched
+            alive = surv.sum() > 0
 
-        # mean over the global batch (reference fed_aggregator.py:332)
+        # mean over the global batch (reference fed_aggregator.py:332):
+        # with dropout, `total` already counts survivor examples only,
+        # so the mean reweights by survivor count automatically
         gradient = transmit / jnp.maximum(total, 1.0)
 
         # server aggregation + decompression
         upd = fserver.get_server_update(
             gradient, server.Vvelocity, server.Verror, cfg, lr,
-            key=jax.random.fold_in(round_key, num_workers))
+            key=jax.random.fold_in(round_key, num_workers),
+            alive=alive)
 
-        new_ps = server.ps_weights - upd.update
+        if alive is None:
+            new_ps = server.ps_weights - upd.update
+        else:
+            # `where` (not `- 0.0`) so a dead round is bit-exact
+            new_ps = jnp.where(alive, server.ps_weights - upd.update,
+                               server.ps_weights)
+        # round_idx advances even on a zero-survivor round: it indexes
+        # the PRNG stream (round_key above), and a frozen index would
+        # replay the identical dropout draw forever
         new_server = ServerState(new_ps, upd.Vvelocity, upd.Verror,
                                  server.round_idx + 1)
 
-        # scatter updated participant rows back
+        # scatter updated participant rows back; a dropped client's
+        # rows are re-written with their GATHERED values, i.e. land
+        # bit-untouched (its error feedback simply waits for the next
+        # round it completes)
+        keep = None if surv is None else surv[:, None] > 0
         new_clients = clients
         if _has_errors(cfg):
+            if keep is not None:
+                new_err = jnp.where(keep, new_err, err_rows)
             new_clients = new_clients._replace(
                 errors=new_clients.errors.at[ids].set(new_err))
         if _has_velocities(cfg):
             if upd.velocity_mask is not None:
                 # true_topk momentum factor masking (fixes ref D6)
                 new_vel = new_vel * upd.velocity_mask[None, :]
+            if keep is not None:
+                new_vel = jnp.where(keep, new_vel, vel_rows)
             new_clients = new_clients._replace(
                 velocities=new_clients.velocities.at[ids].set(new_vel))
         if cfg.do_topk_down:
             # persist each participant's post-download weights so its
             # staleness is tracked (the reference computes but never
-            # stores these — deliberate fix, see module docstring)
+            # stores these — deliberate fix, see module docstring);
+            # a dropped client never received the download, so its
+            # stale-weight row is kept too
+            if keep is not None:
+                new_w = jnp.where(keep, new_w, w_rows)
             new_clients = new_clients._replace(
                 weights=new_clients.weights.at[ids].set(new_w))
 

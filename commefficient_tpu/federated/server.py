@@ -52,10 +52,20 @@ def args2sketch(cfg: Config) -> CSVec:
 
 def get_server_update(gradient: jax.Array, Vvelocity: jax.Array,
                       Verror: jax.Array, cfg: Config, lr,
-                      key: Optional[jax.Array] = None) -> ServerUpdate:
+                      key: Optional[jax.Array] = None,
+                      alive: Optional[jax.Array] = None) -> ServerUpdate:
     """Dispatch on cfg.mode (reference fed_aggregator.py:469-481).
     `lr` may be a scalar or a per-parameter [D] vector (param-group
-    LRs for Fixup nets, reference fed_aggregator.py:411-427)."""
+    LRs for Fixup nets, reference fed_aggregator.py:411-427).
+
+    `alive`: optional traced boolean — False means NO client survived
+    the round (client dropout, round.RoundBatch.survivors). The
+    helper still runs (jit has no cheap dynamic skip), but its result
+    is gated to a no-op: zero weight update and Vvelocity/Verror
+    passed through bit-exactly. Without the gate a zero gradient
+    would still decay momentum (rho * V) and fold V into the error
+    accumulator — state drift from a round in which no information
+    arrived."""
     helper = {
         "sketch": _sketched,
         "local_topk": _local_topk,
@@ -63,7 +73,19 @@ def get_server_update(gradient: jax.Array, Vvelocity: jax.Array,
         "fedavg": _fedavg,
         "uncompressed": _uncompressed,
     }[cfg.mode]
-    return helper(gradient, Vvelocity, Verror, cfg, lr, key)
+    upd = helper(gradient, Vvelocity, Verror, cfg, lr, key)
+    if alive is None:
+        return upd
+    return ServerUpdate(
+        update=jnp.where(alive, upd.update, jnp.zeros_like(upd.update)),
+        Vvelocity=jnp.where(alive, upd.Vvelocity, Vvelocity),
+        Verror=jnp.where(alive, upd.Verror, Verror),
+        # a dead round transmits nothing, so no client velocity
+        # coordinate may be factor-masked either
+        velocity_mask=(None if upd.velocity_mask is None
+                       else jnp.where(alive, upd.velocity_mask,
+                                      jnp.ones_like(upd.velocity_mask))),
+    )
 
 
 def _fedavg(avg_update, Vvelocity, Verror, cfg: Config, lr, key) -> ServerUpdate:

@@ -1,0 +1,84 @@
+"""JAX version compatibility shims.
+
+The engine targets the modern `jax.shard_map` API (top-level export,
+`axis_names=` to leave further mesh axes automatic, `jax.lax.pcast`
+for replicated->varying casts). Older jaxlib builds (<= 0.4.x, still
+what some TPU images pin) only ship `jax.experimental.shard_map` with
+the complementary `auto=` parameter and no varying-type system at all.
+This module presents the modern surface on both:
+
+  * `shard_map(f, mesh=..., in_specs=..., out_specs=..., axis_names=...)`
+    — on old jax, `axis_names` is translated to
+    `auto = mesh.axis_names - axis_names` and rep-checking is disabled
+    (partial-auto mode requires that there anyway);
+  * `pcast(x, axis_name, to="varying")` — on old jax this is the
+    identity: without the varying-type system there is no automatic
+    cotangent psum for unvarying operands, which is exactly the
+    behavior the modern code uses pcast to opt out of;
+  * `axis_size(axis_name)` — `jax.lax.axis_size` where it exists,
+    `psum(1, axis)` (the classic static-size idiom) where it doesn't;
+  * `abstract_mesh()` — the trace's abstract mesh
+    (`jax.sharding.get_abstract_mesh`) on modern jax, None on old jax
+    (which has no abstract-mesh machinery; callers fall back to the
+    concrete mesh).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_MODERN = hasattr(jax, "shard_map")
+
+if _MODERN:
+    def shard_map(f, *, mesh, in_specs, out_specs,
+                  axis_names: Optional[frozenset] = None):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs,
+                  axis_names: Optional[frozenset] = None):
+        manual = (frozenset(mesh.axis_names) if axis_names is None
+                  else frozenset(axis_names))
+        auto = frozenset(mesh.axis_names) - manual
+        # check_rep must be off in partial-auto mode on legacy jax; off
+        # unconditionally so both paths trace the same program class
+        return _legacy_shard_map(f, mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False,
+                                 auto=auto)
+
+
+if hasattr(jax.lax, "pcast"):
+    def pcast(x, axis_name, to: str = "varying"):
+        return jax.lax.pcast(x, axis_name, to=to)
+elif hasattr(jax.lax, "pvary"):
+    def pcast(x, axis_name, to: str = "varying"):
+        assert to == "varying"
+        return jax.lax.pvary(x, axis_name)
+else:
+    def pcast(x, axis_name, to: str = "varying"):
+        # legacy jax has no varying types: grads taken inside a
+        # shard_map body are already shard-local, so the cast the
+        # modern API needs here is a no-op
+        return x
+
+
+if hasattr(jax.lax, "axis_size"):
+    def axis_size(axis_name) -> jax.Array:
+        return jax.lax.axis_size(axis_name)
+else:
+    def axis_size(axis_name):
+        # psum of 1 over a manual axis folds to the static axis size
+        return jax.lax.psum(1, axis_name)
+
+
+def abstract_mesh():
+    """The current trace's abstract mesh, or None when this jax has no
+    abstract-mesh machinery (callers use their concrete mesh)."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    return get() if get is not None else None

@@ -31,6 +31,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from commefficient_tpu.parallel import compat
+
 # (path regex, spec) — first match wins; unmatched leaves replicate.
 # Paths are "/"-joined pytree key paths, e.g.
 # "params/transformer/h_3/attn/c_attn/kernel".
@@ -63,8 +65,8 @@ def constrain_params(params, mesh: Mesh,
     # the engine's partially-manual shard_map the clients axis is
     # Manual (and params arrive clients-varying via pcast), which the
     # concrete mesh — all-Auto axis types — cannot describe
-    am = jax.sharding.get_abstract_mesh()
-    target = am if "model" in am.axis_names else mesh
+    am = compat.abstract_mesh()
+    target = am if am is not None and "model" in am.axis_names else mesh
 
     def constrain(path, leaf):
         s = _path_str(path)

@@ -40,7 +40,8 @@ from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.training.scanloop import run_scanned_rounds
 from commefficient_tpu.utils.checkpoint import (
-    load_checkpoint, save_checkpoint, transfer_for_finetune,
+    latest_checkpoint_path, load_checkpoint, save_final, save_rotating,
+    transfer_for_finetune,
 )
 from commefficient_tpu.utils.logging import (
     TableLogger, Timer, make_logdir,
@@ -297,11 +298,16 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                     writer.add_scalar(name.split(" ")[0], value, epoch)
 
         if cfg.checkpoint_every and epoch % cfg.checkpoint_every == 0:
-            path = _ckpt_path(cfg)
-            save_checkpoint(path, model.server, model.clients,
-                            scheduler_step=lr_scheduler.step_count,
-                            accountant=model.accountant,
-                            prev_change_words=model._prev_change_words)
+            # atomic rotated save: keep-last-k round-stamped files + a
+            # `latest` manifest, so a preemption at ANY instant leaves
+            # a loadable checkpoint for --resume (utils/checkpoint)
+            path = save_rotating(
+                _ckpt_path(cfg), model.server, model.clients,
+                keep_last=cfg.keep_checkpoints,
+                scheduler_step=lr_scheduler.step_count,
+                accountant=model.accountant,
+                prev_change_words=model._prev_change_words,
+                fingerprint=model.checkpoint_fingerprint)
             if mh.is_coordinator():
                 print(f"checkpointed to {path}")
 
@@ -369,8 +375,16 @@ def main(argv=None) -> bool:
     # groups, cv_train.py:377-384)
     lr_scale_vec = None
     if cfg.do_finetune:
-        old_server = load_checkpoint(
-            os.path.join(cfg.finetune_path, cfg.model)).server
+        # resolve like --resume does (manifest -> stamped -> fixed
+        # name): a preempted pretrain run leaves only rotated
+        # checkpoints, and its newest state is still finetunable
+        src = latest_checkpoint_path(
+            os.path.join(cfg.finetune_path, cfg.model))
+        if src is None:
+            raise FileNotFoundError(
+                f"no checkpoint for model {cfg.model!r} under "
+                f"--finetune_path {cfg.finetune_path!r}")
+        old_server = load_checkpoint(src).server
         # rebuild the OLD model's param template to unflatten into
         old_cfg_classes = num_classes_of_dataset(cfg.finetuned_from)
         old_module = models.build_model(
@@ -403,14 +417,20 @@ def main(argv=None) -> bool:
         mh.apply_feed_slices(model, train_loader, val_loader,
                              cfg.num_workers, val_loader.num_shards)
 
-    if cfg.resume and os.path.exists(_ckpt_path(cfg) + ".npz"):
-        ckpt = load_checkpoint(_ckpt_path(cfg))
-        sched_step = model.load_state(ckpt)
-        if mh.is_coordinator():
-            print(f"resumed from {_ckpt_path(cfg)} at round "
-                  f"{int(ckpt.server.round_idx)}")
-    else:
-        sched_step = 0
+    sched_step = 0
+    if cfg.resume:
+        # auto-resume-from-latest: the newest rotated checkpoint via
+        # the manifest, falling back to the legacy fixed-name file;
+        # fingerprint-validated so a wrong checkpoint dir fails with
+        # the offending field named, not a broadcast error
+        ck_file = latest_checkpoint_path(_ckpt_path(cfg))
+        if ck_file is not None:
+            ckpt = load_checkpoint(
+                ck_file, expect_fingerprint=model.checkpoint_fingerprint)
+            sched_step = model.load_state(ckpt)
+            if mh.is_coordinator():
+                print(f"resumed from {ck_file} at round "
+                      f"{int(ckpt.server.round_idx)}")
 
     # LR schedule (reference cv_train.py:392-404; cifar10-fast default
     # knots [0, pivot, num_epochs] -> [0, lr_scale, 0])
@@ -433,11 +453,15 @@ def main(argv=None) -> bool:
     model.finalize()
 
     if cfg.do_checkpoint:
-        # collective (gathers sharded client state); coordinator writes
-        path = save_checkpoint(_ckpt_path(cfg), model.server, model.clients,
-                               scheduler_step=lr_scheduler.step_count,
-                               accountant=model.accountant,
-                               prev_change_words=model._prev_change_words)
+        # collective (gathers sharded client state); coordinator
+        # writes stamped + manifest (what --resume prefers) AND the
+        # fixed-name artifact the finetune path loads, in one gather
+        path = save_final(_ckpt_path(cfg), model.server, model.clients,
+                          keep_last=cfg.keep_checkpoints,
+                          scheduler_step=lr_scheduler.step_count,
+                          accountant=model.accountant,
+                          prev_change_words=model._prev_change_words,
+                          fingerprint=model.checkpoint_fingerprint)
         if coord:
             print(f"saved checkpoint to {path}")
     return ok

@@ -43,7 +43,10 @@ from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 from commefficient_tpu.parallel.tp import tp_loss
 from commefficient_tpu.training.scanloop import run_scanned_rounds
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
-from commefficient_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from commefficient_tpu.utils.checkpoint import (
+    latest_checkpoint_path, load_checkpoint, save_checkpoint,
+    save_final, save_rotating,
+)
 from commefficient_tpu.utils.logging import (
     NullLogger, TableLogger, Timer, make_logdir,
 )
@@ -264,12 +267,17 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
         # the run is killed (symmetric with cv_train.py's per-epoch
         # save; the resume-read half alone would be unreachable)
         if cfg.checkpoint_every and epoch % cfg.checkpoint_every == 0:
-            save_checkpoint(ckpt_path, model.server, model.clients,
-                            scheduler_step=lr_scheduler.step_count,
-                            accountant=model.accountant,
-                            prev_change_words=model._prev_change_words)
+            # atomic rotated save (keep-last-k + `latest` manifest) —
+            # the preemption-safe half of --resume (utils/checkpoint)
+            written = save_rotating(
+                ckpt_path, model.server, model.clients,
+                keep_last=cfg.keep_checkpoints,
+                scheduler_step=lr_scheduler.step_count,
+                accountant=model.accountant,
+                prev_change_words=model._prev_change_words,
+                fingerprint=model.checkpoint_fingerprint)
             if mh.is_coordinator():
-                print(f"checkpointed to {ckpt_path}")
+                print(f"checkpointed to {written}")
 
     n_clients = model.num_clients
     if mh.is_coordinator():
@@ -447,15 +455,20 @@ def main(argv=None) -> bool:
                                [cfg.lr_scale, 0.0])
     lr_scheduler = LambdaLR(opt, lr_lambda=schedule)
 
-    # mid-run resume, symmetric with cv_train.main (cv_train.py:340-353)
+    # mid-run resume, symmetric with cv_train.main: newest rotated
+    # checkpoint via the manifest, legacy fixed-name fallback,
+    # fingerprint-validated (utils/checkpoint)
     ckpt_path = os.path.join(cfg.checkpoint_path, "gpt2")
-    if cfg.resume and os.path.exists(ckpt_path + ".npz"):
-        ckpt = load_checkpoint(ckpt_path)
-        lr_scheduler.load_state_dict(
-            {"step_count": model.load_state(ckpt)})
-        if coord:
-            print(f"resumed from {ckpt_path} at round "
-                  f"{int(ckpt.server.round_idx)}")
+    if cfg.resume:
+        ck_file = latest_checkpoint_path(ckpt_path)
+        if ck_file is not None:
+            ckpt = load_checkpoint(
+                ck_file, expect_fingerprint=model.checkpoint_fingerprint)
+            lr_scheduler.load_state_dict(
+                {"step_count": model.load_state(ckpt)})
+            if coord:
+                print(f"resumed from {ck_file} at round "
+                      f"{int(ckpt.server.round_idx)}")
 
     # only the coordinator creates a run dir (its artifacts are the
     # run's outputs; workers would just litter empty dirs)
@@ -474,10 +487,14 @@ def main(argv=None) -> bool:
         save_checkpoint(os.path.join(log_dir, "gpt2"), model.server,
                         scheduler_step=lr_scheduler.step_count)
         if cfg.do_checkpoint:
-            save_checkpoint(ckpt_path, model.server, model.clients,
-                            scheduler_step=lr_scheduler.step_count,
-                            accountant=model.accountant,
-                            prev_change_words=model._prev_change_words)
+            # stamped + manifest (what --resume prefers) AND the
+            # fixed-name artifact, in one collective gather
+            save_final(ckpt_path, model.server, model.clients,
+                       keep_last=cfg.keep_checkpoints,
+                       scheduler_step=lr_scheduler.step_count,
+                       accountant=model.accountant,
+                       prev_change_words=model._prev_change_words,
+                       fingerprint=model.checkpoint_fingerprint)
         # HF-style final artifact: tokenizer + config + weights
         # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
         if coord:

@@ -9,10 +9,30 @@ full training state — PS weights, server momentum/error state, round
 counter, per-client persistent state, scheduler step — round-trips
 through one .npz file, enabling both the reference's end-of-training
 save and true mid-run resume.
+
+Preemption safety (the ROADMAP north-star environment is preemptible
+TPU pods):
+
+  * every write is ATOMIC — the bytes go to `<path>.tmp` and only a
+    successful flush is `os.replace`d over the real name, so a
+    preemption mid-write can never corrupt the previous checkpoint;
+  * `save_rotating` keeps the newest `keep_last` round-stamped files
+    plus a `<prefix>.latest` JSON manifest; `load_latest` resumes from
+    the manifest (falling back to a glob, then to the legacy fixed
+    `<prefix>.npz` name);
+  * each checkpoint embeds a config FINGERPRINT
+    (mode/grad_size/num_clients/error_type); `load_checkpoint`
+    validates it against the resuming run and raises
+    `CheckpointMismatchError` naming the offending field — instead of
+    the opaque KeyError/broadcast error a shape mismatch used to
+    surface as.
 """
 from __future__ import annotations
 
+import glob as _glob
+import json
 import os
+import shutil
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -21,6 +41,48 @@ import numpy as np
 
 from commefficient_tpu.federated.round import ClientState, ServerState
 from commefficient_tpu.parallel import multihost as mh
+
+# the config fields a checkpoint must agree on to be loadable into a
+# run (order fixed; all serialized as strings in the .npz)
+FINGERPRINT_FIELDS = ("mode", "grad_size", "num_clients", "error_type")
+
+
+class CheckpointMismatchError(ValueError):
+    """A checkpoint written under a different config was loaded into
+    this run. Carries the first offending fingerprint field so the
+    error is actionable ('grad_size: checkpoint has 7840, this run
+    expects 122570') rather than an opaque broadcast failure."""
+
+    def __init__(self, path: str, field: str, found, expected):
+        self.field, self.found, self.expected = field, found, expected
+        super().__init__(
+            f"checkpoint {path!r} does not match this run's config: "
+            f"{field}: checkpoint has {found!r}, this run expects "
+            f"{expected!r}. Point --checkpoint_path at a checkpoint "
+            f"written with the same mode/model/client-count, or start "
+            f"fresh without --resume.")
+
+
+def config_fingerprint(cfg, num_clients: Optional[int] = None) -> dict:
+    """The compatibility fingerprint embedded in every checkpoint."""
+    return {
+        "mode": cfg.mode,
+        "grad_size": int(cfg.grad_size),
+        "num_clients": int(num_clients if num_clients is not None
+                           else (cfg.num_clients or 0)),
+        "error_type": cfg.error_type,
+    }
+
+
+def validate_fingerprint(found: dict, expected: dict,
+                         path: str) -> None:
+    """Raise CheckpointMismatchError on the first FINGERPRINT_FIELDS
+    entry where `found` disagrees with `expected`. Fields absent from
+    `found` (legacy partial fingerprints) are skipped; values compare
+    as strings (the .npz round-trips them that way)."""
+    for k in FINGERPRINT_FIELDS:
+        if k in found and str(found[k]) != str(expected[k]):
+            raise CheckpointMismatchError(path, k, found[k], expected[k])
 
 
 class Checkpoint(NamedTuple):
@@ -31,6 +93,7 @@ class Checkpoint(NamedTuple):
     scheduler_step: int
     accountant_state: Optional[dict] = None
     prev_change_words: Optional[np.ndarray] = None
+    fingerprint: Optional[dict] = None
 
 
 def save_checkpoint(path: str, server: ServerState,
@@ -39,13 +102,21 @@ def save_checkpoint(path: str, server: ServerState,
                     include_clients: bool = True,
                     accountant=None,
                     prev_change_words: Optional[np.ndarray] = None,
-                    chunk_rows: int = 256) -> str:
+                    chunk_rows: int = 256,
+                    fingerprint: Optional[dict] = None) -> str:
     """Write training state to `path` (.npz appended if absent).
     Per-client state can be excluded (include_clients=False) to keep
     files small when clients are stateless (error_type != local and
     no local momentum). Pass the FedModel's CommAccountant (and its
     _prev_change_words bitset) so resumed runs continue download
-    accounting instead of restarting from 'round 1 is free'."""
+    accounting instead of restarting from 'round 1 is free'.
+
+    The write is ATOMIC on the coordinator: bytes land in
+    `<path>.tmp` and are `os.replace`d over the final name only after
+    a successful flush, so a preemption mid-write leaves the previous
+    checkpoint intact (a stray .tmp at most). Pass `fingerprint`
+    (config_fingerprint(...)) so load_checkpoint can reject a resume
+    under an incompatible config with an actionable error."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if not path.endswith(".npz"):
         path = path + ".npz"
@@ -73,9 +144,16 @@ def save_checkpoint(path: str, server: ServerState,
             arrays[f"acct_{k}"] = v
     if prev_change_words is not None:
         arrays["acct_prev_change_words"] = np.asarray(prev_change_words)
+    if fingerprint is not None:
+        for k in FINGERPRINT_FIELDS:
+            arrays[f"fp_{k}"] = np.asarray(str(fingerprint[k]))
     if mh.is_coordinator():
-        with open(path, "wb") as f:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
     mh.sync_processes("checkpoint-written")
     return path
 
@@ -101,11 +179,34 @@ def _gather_rows(x, chunk_rows: int = 256):
     return out if out is not None else np.zeros((0,), np.float32)
 
 
-def load_checkpoint(path: str) -> Checkpoint:
-    """Read training state back."""
+def load_checkpoint(path: str,
+                    expect_fingerprint: Optional[dict] = None
+                    ) -> Checkpoint:
+    """Read training state back.
+
+    `expect_fingerprint`: the resuming run's config_fingerprint(...) /
+    FedModel.checkpoint_fingerprint. A checkpoint carrying a
+    different fingerprint raises CheckpointMismatchError naming the
+    offending field. Legacy checkpoints without a fingerprint get a
+    best-effort grad_size check from the stored ps_weights shape —
+    still a clear error instead of the downstream broadcast failure."""
     if not path.endswith(".npz"):
         path = path + ".npz"
     z = np.load(path)
+    fingerprint = None
+    if "fp_mode" in z.files:
+        # tolerate partial fingerprints: a checkpoint written before
+        # FINGERPRINT_FIELDS grew lacks the newer fp_* entries —
+        # validate_fingerprint skips absent fields
+        fingerprint = {k: str(z[f"fp_{k}"]) for k in FINGERPRINT_FIELDS
+                       if f"fp_{k}" in z.files}
+    if expect_fingerprint is not None:
+        found = fingerprint
+        if found is None:
+            # legacy file: the flat weight vector length is still a
+            # decisive compatibility signal
+            found = {"grad_size": str(int(z["ps_weights"].shape[0]))}
+        validate_fingerprint(found, expect_fingerprint, path)
     server = ServerState(
         ps_weights=jnp.asarray(z["ps_weights"]),
         Vvelocity=jnp.asarray(z["Vvelocity"]),
@@ -124,7 +225,140 @@ def load_checkpoint(path: str) -> Checkpoint:
     prev = (z["acct_prev_change_words"]
             if "acct_prev_change_words" in z.files else None)
     return Checkpoint(server, clients, int(z["scheduler_step"]),
-                      acct or None, prev)
+                      acct or None, prev, fingerprint)
+
+
+# ---------------- keep-last-k rotation + latest manifest -----------------
+
+def _manifest_path(prefix: str) -> str:
+    return prefix + ".latest"
+
+
+def _round_stamp(basename: str) -> int:
+    """Round index from a `<name>-r<round:08d>.npz` basename, or -1
+    for anything that doesn't match the stamp pattern."""
+    try:
+        return int(basename.rsplit("-r", 1)[1].split(".", 1)[0])
+    except (IndexError, ValueError):
+        return -1
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def save_rotating(prefix: str, server: ServerState,
+                  clients: Optional[ClientState] = None,
+                  keep_last: int = 3, **kw) -> str:
+    """Atomic round-stamped save + `<prefix>.latest` manifest update +
+    keep-last-k pruning. Returns the written path.
+
+    Files are `<prefix>-r<round:08d>.npz`; the manifest is JSON
+    {"latest": basename, "history": [basenames newest-first]} written
+    atomically AFTER the checkpoint itself, so a preemption between
+    the two leaves the manifest pointing at the previous (intact)
+    file. Pruning removes only files the rotation itself wrote (they
+    must match the stamp pattern), never a legacy fixed-name
+    checkpoint. Collective in multi-controller runs (save_checkpoint
+    gathers); only the coordinator touches the filesystem."""
+    round_idx = int(np.asarray(mh.gather_host(server.round_idx)))
+    path = f"{prefix}-r{round_idx:08d}.npz"
+    save_checkpoint(path, server, clients, **kw)
+    if mh.is_coordinator():
+        base = os.path.basename(path)
+        mpath = _manifest_path(prefix)
+        history = []
+        try:
+            with open(mpath) as f:
+                history = list(json.load(f).get("history", []))
+        except (OSError, ValueError):
+            pass
+        # entries stamped AFTER this round belong to an abandoned
+        # timeline (a dir reused without --resume, or a resume from an
+        # older checkpoint): drop them from the history so the prune
+        # below removes their files — otherwise a lost manifest would
+        # let the glob fallback resume the abandoned run
+        history = [h for h in history if _round_stamp(h) <= round_idx]
+        history = [base] + [h for h in history if h != base]
+        keep = history[:max(keep_last, 1)]
+        _atomic_write_text(mpath, json.dumps(
+            {"latest": base, "history": keep}, indent=2))
+        # prune every stamped file NOT in the kept history (not just
+        # the manifest's own tail): a lost/corrupt manifest must not
+        # orphan earlier stamped files forever, and stale
+        # higher-round files from a pre-resume timeline must not
+        # shadow the live one in the glob fallback
+        keep_set = set(keep)
+        for old in _glob.glob(prefix + "-r*.npz"):
+            if os.path.basename(old) not in keep_set:
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+    mh.sync_processes("checkpoint-rotated")
+    return path
+
+
+def save_final(prefix: str, server: ServerState,
+               clients: Optional[ClientState] = None,
+               keep_last: int = 3, **kw) -> str:
+    """End-of-run save: ONE collective gather, two artifacts — the
+    rotated stamped checkpoint (+ manifest, so a later --resume sees
+    this final state) and the legacy fixed `<prefix>.npz` the
+    finetune/HF tooling loads. The fixed name is a coordinator-side
+    atomic copy of the stamped bytes, not a second gather+serialize
+    (which would double a multi-GB device->host transfer at
+    shutdown). Returns the fixed-name path."""
+    stamped = save_rotating(prefix, server, clients,
+                            keep_last=keep_last, **kw)
+    fixed = prefix if prefix.endswith(".npz") else prefix + ".npz"
+    if mh.is_coordinator():
+        tmp = fixed + ".tmp"
+        shutil.copyfile(stamped, tmp)
+        os.replace(tmp, fixed)
+    mh.sync_processes("checkpoint-final")
+    return fixed
+
+
+def latest_checkpoint_path(prefix: str) -> Optional[str]:
+    """Resolve the newest checkpoint for `prefix`: the manifest's
+    `latest` entry if it names an existing file, else the
+    highest-round `<prefix>-r*.npz` on disk (manifest lost), else the
+    legacy fixed `<prefix>.npz`, else None."""
+    ckpt_dir = os.path.dirname(prefix) or "."
+    try:
+        with open(_manifest_path(prefix)) as f:
+            base = json.load(f).get("latest")
+        if base:
+            cand = os.path.join(ckpt_dir, base)
+            if os.path.exists(cand):
+                return cand
+    except (OSError, ValueError):
+        pass
+    stamped = sorted(_glob.glob(prefix + "-r*.npz"))
+    if stamped:
+        return stamped[-1]
+    if os.path.exists(prefix + ".npz"):
+        return prefix + ".npz"
+    return None
+
+
+def load_latest(prefix: str,
+                expect_fingerprint: Optional[dict] = None
+                ) -> Optional[Checkpoint]:
+    """Auto-resume entry point: load the newest checkpoint for
+    `prefix` (see latest_checkpoint_path), or None when there is
+    nothing to resume from. Fingerprint-validated like
+    load_checkpoint."""
+    path = latest_checkpoint_path(prefix)
+    if path is None:
+        return None
+    return load_checkpoint(path, expect_fingerprint=expect_fingerprint)
 
 
 def transfer_for_finetune(old_params, new_template):

@@ -1,0 +1,107 @@
+"""Deterministic fault injection for federated rounds.
+
+The round engine models two failure classes that real federated
+deployments (FetchSGD's target setting) and preemptible TPU pods
+exhibit and the reference never does:
+
+  * client dropout — a sampled client fails to complete a round: its
+    upload is excluded from aggregation, its persistent state rows are
+    bit-untouched, and accounting charges it nothing;
+  * run preemption — the whole training process dies between rounds
+    and must resume from the newest checkpoint bit-exactly.
+
+Both are driven from this module so tests can script failures
+deterministically: `FaultSchedule` says exactly which clients drop in
+which round and after which round the run "crashes" (a raised
+`InjectedFault`), and `bernoulli_survivors` is the production-path
+random dropout draw (`Config.client_dropout`), a pure function of
+(seed, round) so a resumed run replays the identical survivor
+sequence.
+
+The schedule is consumed host-side by `FedModel` (federated/api.py):
+the survivor mask it produces is passed into the jitted round as data
+(`round.RoundBatch.survivors`), which keeps the mask visible to the
+host accounting without any device sync, and keeps the jitted program
+itself schedule-agnostic.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """Raised by FedModel when a FaultSchedule says the run crashes
+    after a given round. The round index that completed last rides
+    along so tests can checkpoint/resume at exactly that boundary."""
+
+    def __init__(self, round_idx: int):
+        super().__init__(
+            f"injected fault: crash after round {round_idx}")
+        self.round_idx = int(round_idx)
+
+
+def bernoulli_survivors(seed: int, round_idx: int, num_workers: int,
+                        dropout: float) -> np.ndarray:
+    """The production dropout draw: [num_workers] f32 {0,1} survivor
+    mask, Bernoulli(1 - dropout) per participant slot.
+
+    Pure function of (seed, round_idx): resuming from a checkpoint at
+    round k replays rounds k+1.. with the identical masks an
+    uninterrupted run would have drawn — required for the crash->resume
+    bit-equivalence contract. Drawn host-side with a counter-based
+    numpy generator (no global RNG state)."""
+    if dropout <= 0.0:
+        return np.ones(num_workers, np.float32)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed), 0x0D120, int(round_idx)]))
+    return (rng.random(num_workers) >= dropout).astype(np.float32)
+
+
+@dataclass(frozen=True)
+class FaultSchedule:
+    """A deterministic script of failures for one training run.
+
+    drop:        {round_idx: client ids that drop that round}. Ids are
+                 GLOBAL client ids; a listed id only matters if that
+                 client was sampled into the round.
+    drop_slots:  {round_idx: participant SLOT indices that drop} — for
+                 tests that care about position in the round rather
+                 than identity (e.g. "slot 0 of round 2").
+    drop_all:    rounds where every sampled client drops (the
+                 zero-survivor no-op case).
+    crash_after: raise InjectedFault once the given round has fully
+                 completed (state updated, accounting recorded) — the
+                 preemption point a checkpoint/resume test recovers
+                 from. None = never crash.
+    """
+    drop: Mapping[int, Sequence[int]] = field(default_factory=dict)
+    drop_slots: Mapping[int, Sequence[int]] = field(default_factory=dict)
+    drop_all: Sequence[int] = ()
+    crash_after: Optional[int] = None
+
+    def survival_mask(self, round_idx: int,
+                      client_ids: np.ndarray) -> Optional[np.ndarray]:
+        """[W] f32 survivor mask for this round, or None when the
+        schedule says nothing about it (round runs untouched)."""
+        round_idx = int(round_idx)
+        client_ids = np.asarray(client_ids)
+        if round_idx in set(int(r) for r in self.drop_all):
+            return np.zeros(client_ids.shape[0], np.float32)
+        mask = None
+        dropped = self.drop.get(round_idx)
+        if dropped is not None:
+            mask = (~np.isin(client_ids,
+                             np.asarray(dropped))).astype(np.float32)
+        slots = self.drop_slots.get(round_idx)
+        if slots is not None:
+            if mask is None:
+                mask = np.ones(client_ids.shape[0], np.float32)
+            mask[np.asarray(slots, np.int64)] = 0.0
+        return mask
+
+    def should_crash(self, round_idx: int) -> bool:
+        return (self.crash_after is not None
+                and int(round_idx) == int(self.crash_after))

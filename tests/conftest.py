@@ -35,3 +35,12 @@ def mesh():
     from commefficient_tpu.parallel.mesh import make_client_mesh
 
     return make_client_mesh(len(jax.devices()))
+
+
+@pytest.fixture
+def ckpt_dir(tmp_path):
+    """Isolated checkpoint directory per test: checkpoint/rotation
+    tests never see each other's manifests or stamped files."""
+    d = tmp_path / "ckpts"
+    d.mkdir()
+    return str(d)

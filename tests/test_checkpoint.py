@@ -1,0 +1,218 @@
+"""Preemption-safe checkpointing: atomic writes, keep-last-k rotation
+with a `latest` manifest, config fingerprints, and the actionable
+mismatch error (ISSUE 1 satellites)."""
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.federated.round import ClientState, ServerState
+from commefficient_tpu.utils.checkpoint import (
+    CheckpointMismatchError, config_fingerprint, latest_checkpoint_path,
+    load_checkpoint, load_latest, save_checkpoint, save_final,
+    save_rotating,
+)
+
+D = 8
+
+
+def _server(round_idx=0, fill=1.0):
+    return ServerState(
+        ps_weights=jnp.full((D,), fill, jnp.float32),
+        Vvelocity=jnp.zeros((D,), jnp.float32),
+        Verror=jnp.zeros((D,), jnp.float32),
+        round_idx=jnp.asarray(round_idx, jnp.int32),
+    )
+
+
+def _cfg(**kw):
+    base = dict(mode="uncompressed", grad_size=D, num_workers=8,
+                local_momentum=0.0, virtual_momentum=0.0,
+                error_type="none", num_clients=8)
+    base.update(kw)
+    return Config(**base)
+
+
+# ---------------- atomicity ----------------------------------------------
+
+def test_save_is_atomic_no_tmp_left(ckpt_dir):
+    path = save_checkpoint(os.path.join(ckpt_dir, "ck"), _server())
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_truncated_tmp_does_not_corrupt_previous(ckpt_dir):
+    """Simulated preemption mid-write: a half-written .tmp next to the
+    real file must leave the previous checkpoint fully loadable, and a
+    later successful save must atomically supersede it."""
+    path = save_checkpoint(os.path.join(ckpt_dir, "ck"),
+                           _server(round_idx=3, fill=7.0))
+    # preemption strikes mid-save: garbage bytes in the tmp file
+    with open(path + ".tmp", "wb") as f:
+        f.write(b"PK\x03\x04 truncated npz junk")
+    ckpt = load_checkpoint(path)
+    assert int(ckpt.server.round_idx) == 3
+    np.testing.assert_array_equal(np.asarray(ckpt.server.ps_weights), 7.0)
+    # the next save replaces both cleanly
+    save_checkpoint(os.path.join(ckpt_dir, "ck"),
+                    _server(round_idx=4, fill=9.0))
+    assert int(load_checkpoint(path).server.round_idx) == 4
+    assert not os.path.exists(path + ".tmp")
+
+
+# ---------------- rotation + latest manifest -----------------------------
+
+def test_rotation_keeps_last_k_and_manifest(ckpt_dir):
+    prefix = os.path.join(ckpt_dir, "run")
+    for r in range(5):
+        save_rotating(prefix, _server(round_idx=r, fill=float(r)),
+                      keep_last=3)
+    stamped = sorted(f for f in os.listdir(ckpt_dir)
+                     if f.startswith("run-r") and f.endswith(".npz"))
+    assert stamped == ["run-r00000002.npz", "run-r00000003.npz",
+                       "run-r00000004.npz"]
+    with open(prefix + ".latest") as f:
+        manifest = json.load(f)
+    assert manifest["latest"] == "run-r00000004.npz"
+    assert manifest["history"] == ["run-r00000004.npz",
+                                   "run-r00000003.npz",
+                                   "run-r00000002.npz"]
+    ckpt = load_latest(prefix)
+    assert int(ckpt.server.round_idx) == 4
+    np.testing.assert_array_equal(np.asarray(ckpt.server.ps_weights), 4.0)
+
+
+def test_load_latest_survives_lost_manifest(ckpt_dir):
+    prefix = os.path.join(ckpt_dir, "run")
+    for r in (1, 2):
+        save_rotating(prefix, _server(round_idx=r, fill=float(r)))
+    os.remove(prefix + ".latest")
+    assert latest_checkpoint_path(prefix).endswith("run-r00000002.npz")
+    assert int(load_latest(prefix).server.round_idx) == 2
+
+
+def test_rotation_prunes_orphans_after_lost_manifest(ckpt_dir):
+    """A lost manifest must not orphan earlier stamped files forever:
+    the next rotation prunes every stamped file outside the rebuilt
+    history (pruning globs the stamp pattern, it doesn't trust the
+    manifest)."""
+    prefix = os.path.join(ckpt_dir, "run")
+    for r in range(3):
+        save_rotating(prefix, _server(round_idx=r), keep_last=2)
+    os.remove(prefix + ".latest")
+    save_rotating(prefix, _server(round_idx=3), keep_last=2)
+    stamped = sorted(f for f in os.listdir(ckpt_dir)
+                     if f.startswith("run-r") and f.endswith(".npz"))
+    assert stamped == ["run-r00000003.npz"]
+
+
+def test_rotation_prunes_abandoned_higher_round_timeline(ckpt_dir):
+    """Reusing a checkpoint dir without --resume (or resuming from an
+    older round) must prune the abandoned timeline's higher-round
+    stamped files — otherwise a later lost manifest would let the
+    glob fallback resume the abandoned run."""
+    prefix = os.path.join(ckpt_dir, "run")
+    for r in (8, 9, 10):
+        save_rotating(prefix, _server(round_idx=r), keep_last=3)
+    # a fresh run starts over in the same dir at round 1
+    save_rotating(prefix, _server(round_idx=1, fill=5.0), keep_last=3)
+    stamped = sorted(f for f in os.listdir(ckpt_dir)
+                     if f.startswith("run-r") and f.endswith(".npz"))
+    assert stamped == ["run-r00000001.npz"]
+    os.remove(prefix + ".latest")  # even with the manifest lost...
+    assert int(load_latest(prefix).server.round_idx) == 1
+
+
+def test_save_final_fixed_name_and_manifest_agree(ckpt_dir):
+    """save_final: one gather, two artifacts — the fixed name the
+    finetune tooling loads and the manifest-tracked stamped copy
+    --resume prefers, holding the same state."""
+    prefix = os.path.join(ckpt_dir, "fin")
+    save_rotating(prefix, _server(round_idx=2, fill=1.0), keep_last=2)
+    path = save_final(prefix, _server(round_idx=5, fill=2.0),
+                      keep_last=2)
+    assert path == prefix + ".npz"
+    assert int(load_checkpoint(path).server.round_idx) == 5
+    resumed = load_latest(prefix)
+    assert int(resumed.server.round_idx) == 5
+    np.testing.assert_array_equal(np.asarray(resumed.server.ps_weights),
+                                  2.0)
+
+
+def test_load_latest_legacy_fixed_name_fallback(ckpt_dir):
+    prefix = os.path.join(ckpt_dir, "legacy")
+    save_checkpoint(prefix, _server(round_idx=9))
+    assert int(load_latest(prefix).server.round_idx) == 9
+
+
+def test_load_latest_none_when_nothing_saved(ckpt_dir):
+    assert load_latest(os.path.join(ckpt_dir, "absent")) is None
+
+
+# ---------------- fingerprint validation ---------------------------------
+
+def test_fingerprint_roundtrip_and_mismatch(ckpt_dir):
+    cfg = _cfg(mode="sketch", error_type="virtual")
+    fp = config_fingerprint(cfg, num_clients=8)
+    path = save_checkpoint(os.path.join(ckpt_dir, "fp"), _server(),
+                           fingerprint=fp)
+    ok = load_checkpoint(path, expect_fingerprint=fp)
+    assert ok.fingerprint["mode"] == "sketch"
+
+    other = config_fingerprint(_cfg(mode="fedavg"), num_clients=8)
+    with pytest.raises(CheckpointMismatchError) as exc:
+        load_checkpoint(path, expect_fingerprint=other)
+    assert exc.value.field == "mode"
+    assert "sketch" in str(exc.value) and "fedavg" in str(exc.value)
+
+
+def test_legacy_checkpoint_wrong_grad_size_is_actionable(ckpt_dir):
+    """A fingerprint-less (legacy) checkpoint from a different model
+    size must fail with grad_size named — not a downstream broadcast
+    KeyError."""
+    path = save_checkpoint(os.path.join(ckpt_dir, "old"), _server())
+    expect = config_fingerprint(_cfg(grad_size=12345), num_clients=8)
+    with pytest.raises(CheckpointMismatchError) as exc:
+        load_checkpoint(path, expect_fingerprint=expect)
+    assert exc.value.field == "grad_size"
+    assert "12345" in str(exc.value)
+
+
+def test_fed_model_load_state_rejects_mismatch(ckpt_dir):
+    """FedModel.load_state validates the fingerprint even when the
+    caller skipped it at load time."""
+    def loss_fn(params, batch, mask):
+        x, y = batch
+        pred = x @ params["w"]
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (((pred - y) ** 2) * mask).sum() / denom
+        return loss, (loss,)
+
+    model = FedModel(None, loss_fn, _cfg(),
+                     params={"w": jnp.zeros(D)})
+    FedOptimizer(model)
+    wrong_fp = config_fingerprint(_cfg(mode="fedavg"), num_clients=8)
+    path = save_checkpoint(os.path.join(ckpt_dir, "wrong"), _server(),
+                           fingerprint=wrong_fp)
+    ckpt = load_checkpoint(path)  # no expectation passed here
+    with pytest.raises(CheckpointMismatchError) as exc:
+        model.load_state(ckpt)
+    assert exc.value.field == "mode"
+
+
+def test_client_state_roundtrips_through_rotation(ckpt_dir):
+    clients = ClientState(
+        errors=jnp.arange(2 * D, dtype=jnp.float32).reshape(2, D),
+        velocities=jnp.ones((2, D), jnp.float32) * 3.5,
+        weights=jnp.zeros((0,), jnp.float32),
+    )
+    prefix = os.path.join(ckpt_dir, "cs")
+    save_rotating(prefix, _server(round_idx=2), clients)
+    out = load_latest(prefix)
+    np.testing.assert_array_equal(np.asarray(out.clients.errors),
+                                  np.asarray(clients.errors))
+    np.testing.assert_array_equal(np.asarray(out.clients.velocities), 3.5)

@@ -56,10 +56,35 @@ def test_checkpoint_and_resume(tmp_path):
                     "--checkpoint_path", ck, "--num_epochs", "0.1")
 
 
+def test_smoke_dropout_rotating_checkpoint_resume(tmp_path):
+    """--client_dropout + per-epoch rotating checkpoints: the driver
+    writes round-stamped files plus a `latest` manifest, and --resume
+    picks the newest one up (fault-tolerance wiring, ISSUE 1)."""
+    import glob
+    import json
+
+    ck = str(tmp_path / "ck")
+    assert run_main(tmp_path, "--mode", "uncompressed",
+                    "--client_dropout", "0.3",
+                    "--checkpoint_every", "1", "--keep_checkpoints", "2",
+                    "--checkpoint_path", ck)
+    stamped = glob.glob(os.path.join(ck, "ResNet9-r*.npz"))
+    assert stamped, "rotating save wrote no stamped checkpoint"
+    with open(os.path.join(ck, "ResNet9.latest")) as f:
+        assert json.load(f)["latest"] in [os.path.basename(p)
+                                          for p in stamped]
+    assert run_main(tmp_path, "--mode", "uncompressed",
+                    "--client_dropout", "0.3", "--resume",
+                    "--checkpoint_path", ck, "--num_epochs", "0.1")
+
+
 def test_finetune_head_swap(tmp_path):
     ck = str(tmp_path / "ck")
     assert run_main(tmp_path, "--mode", "uncompressed",
                     "--checkpoint", "--checkpoint_path", ck)
+    # finetune must also work from a PREEMPTED pretrain run — only
+    # rotated stamped checkpoints on disk, no fixed-name artifact
+    os.remove(os.path.join(ck, "ResNet9.npz"))
     assert cv_train.main([
         "--test", "--dataset_name", "CIFAR100",
         "--dataset_dir", str(tmp_path / "ds"),

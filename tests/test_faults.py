@@ -1,0 +1,404 @@
+"""Fault-tolerant round semantics: client dropout + injected crashes +
+checkpoint/resume bit-equivalence (ISSUE 1).
+
+Dropout contract under test (round.RoundBatch.survivors):
+  * aggregation reweights by SURVIVOR example count;
+  * a dropped client's persistent error/velocity/stale-weight rows are
+    bit-untouched and its upload/download bytes are zero;
+  * a zero-survivor round leaves ps_weights/Vvelocity/Verror bit-exact
+    (round_idx alone advances — it indexes the PRNG stream);
+  * crash-after-round-k (utils.faults.InjectedFault) + resume from the
+    newest rotated checkpoint reproduces the uninterrupted run
+    BIT-identically, for sketch / true_topk / fedavg, with random
+    client_dropout active across the crash boundary.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.federated.round import (
+    RoundBatch, init_client_state, init_server_state, make_round_fns,
+)
+from commefficient_tpu.ops.flat import flatten_params
+from commefficient_tpu.parallel.mesh import make_client_mesh
+from commefficient_tpu.utils.checkpoint import load_latest, save_rotating
+from commefficient_tpu.utils.faults import (
+    FaultSchedule, InjectedFault, bernoulli_survivors,
+)
+
+D = 8
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+def _problem(seed=0, W=8, B=4):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(W, B, D).astype(np.float32)
+    y = rng.randn(W, B).astype(np.float32)
+    return x, y
+
+
+def _engine(mesh, mode="uncompressed", num_workers=8, **kw):
+    params = {"w": jnp.zeros(D)}
+    vec, unravel = flatten_params(params)
+    base = dict(mode=mode, grad_size=D, weight_decay=0.0,
+                num_workers=num_workers, local_momentum=0.0,
+                virtual_momentum=0.0, error_type="none",
+                microbatch_size=-1, num_clients=num_workers)
+    base.update(kw)
+    cfg = Config(**base)
+    train_round, _ = make_round_fns(loss_fn, unravel, cfg, mesh)
+    server = init_server_state(cfg, vec)
+    clients = init_client_state(cfg, base["num_clients"], vec)
+    return cfg, train_round, server, clients
+
+
+def _fed_model(mode, **kw):
+    base = dict(mode=mode, grad_size=D, weight_decay=0.0, num_workers=8,
+                local_momentum=0.0, virtual_momentum=0.0,
+                error_type="none", microbatch_size=-1, num_clients=8)
+    base.update(kw)
+    model = FedModel(None, loss_fn, Config(**base),
+                     params={"w": jnp.zeros(D)})
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+# ---------------- dropout semantics --------------------------------------
+
+def test_zero_survivor_round_is_noop(mesh):
+    """All clients dropping leaves every ServerState array bit-exact;
+    only round_idx advances (it indexes the PRNG stream). sketch +
+    virtual error/momentum so server state is nontrivial."""
+    _, tr, server, clients = _engine(
+        mesh, "sketch", k=2, num_rows=2, num_cols=64, num_blocks=1,
+        error_type="virtual", virtual_momentum=0.9)
+    x, y = _problem()
+    key = jax.random.PRNGKey(0)
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                       jnp.ones((8, 4)))
+    # one real round first (k=2 < D keeps untransmitted mass in the
+    # virtual error table, so the state a dead round must preserve is
+    # nontrivial)
+    server, clients, _ = tr(server, clients, batch._replace(
+        survivors=jnp.ones(8)), 0.1, key)
+    assert float(jnp.abs(server.Verror).sum()) > 0
+
+    dead = batch._replace(survivors=jnp.zeros(8))
+    s2, c2, metrics = tr(server, clients, dead, 0.1, key)
+    np.testing.assert_array_equal(np.asarray(s2.ps_weights),
+                                  np.asarray(server.ps_weights))
+    np.testing.assert_array_equal(np.asarray(s2.Vvelocity),
+                                  np.asarray(server.Vvelocity))
+    np.testing.assert_array_equal(np.asarray(s2.Verror),
+                                  np.asarray(server.Verror))
+    assert int(s2.round_idx) == int(server.round_idx) + 1
+    np.testing.assert_array_equal(np.asarray(metrics.num_examples), 0.0)
+
+
+def test_dropped_client_state_rows_bit_untouched(mesh):
+    """local_topk + local error + local momentum: a dropped client's
+    error AND velocity rows come back bit-identical while survivors'
+    rows move."""
+    _, tr, server, clients = _engine(
+        mesh, "local_topk", k=2, error_type="local", local_momentum=0.5)
+    x, y = _problem()
+    key = jax.random.PRNGKey(0)
+    full = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
+                      jnp.ones((8, 4)), jnp.ones(8))
+    # a first full round gives every client nonzero error/velocity
+    server, clients, _ = tr(server, clients, full, 0.1, key)
+    before_err = np.asarray(clients.errors)
+    before_vel = np.asarray(clients.velocities)
+    assert np.all(np.abs(before_err).sum(1) > 0)
+
+    surv = np.ones(8, np.float32)
+    dropped = [1, 4, 6]
+    surv[dropped] = 0.0
+    server, clients, _ = tr(server, clients,
+                            full._replace(survivors=jnp.asarray(surv)),
+                            0.1, key)
+    after_err = np.asarray(clients.errors)
+    after_vel = np.asarray(clients.velocities)
+    for c in range(8):
+        if c in dropped:
+            np.testing.assert_array_equal(after_err[c], before_err[c])
+            np.testing.assert_array_equal(after_vel[c], before_vel[c])
+        else:
+            assert not np.array_equal(after_err[c], before_err[c])
+
+
+def test_survivor_reweighting_two_client_hand_case():
+    """2 clients, client 1 dropped: the round must equal the one-client
+    mean — update = lr * mean-grad(client 0) — not the half-weight the
+    pre-dropout divide-by-all-counts would give."""
+    mesh2 = make_client_mesh(2)
+    _, tr, server, clients = _engine(mesh2, "uncompressed",
+                                     num_workers=2)
+    x, y = _problem(seed=1, W=2)
+    key = jax.random.PRNGKey(0)
+    batch = RoundBatch(jnp.arange(2, dtype=jnp.int32), (x, y),
+                       jnp.ones((2, 4)), jnp.asarray([1.0, 0.0]))
+    s1, _, metrics = tr(server, clients, batch, 0.1, key)
+
+    # hand-computed: w0 = 0 -> grad = mean_b x0_b * (x0_b @ 0 - y0_b)
+    g0 = (x[0] * (x[0] @ np.zeros(D) - y[0])[:, None]).mean(0)
+    np.testing.assert_allclose(np.asarray(s1.ps_weights), -0.1 * g0,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(metrics.num_examples),
+                                  [4.0, 0.0])
+
+
+def test_ones_survivors_match_no_mask(mesh):
+    """An all-survivors mask is numerically identical to the mask-free
+    program (both fused and per-client paths)."""
+    x, y = _problem(seed=2)
+    key = jax.random.PRNGKey(0)
+    for mode, extra in (("uncompressed", {}),        # fused backward
+                        ("local_topk", dict(k=2, error_type="local"))):
+        _, tr, server, clients = _engine(mesh, mode, **extra)
+        ids = jnp.arange(8, dtype=jnp.int32)
+        plain = RoundBatch(ids, (x, y), jnp.ones((8, 4)))
+        masked = plain._replace(survivors=jnp.ones(8))
+        s_a, c_a, _ = tr(server, clients, plain, 0.1, key)
+        s_b, c_b, _ = tr(server, clients, masked, 0.1, key)
+        np.testing.assert_allclose(np.asarray(s_a.ps_weights),
+                                   np.asarray(s_b.ps_weights),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(c_a.errors),
+                                   np.asarray(c_b.errors),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_client_dropout_zero_traces_maskfree_program():
+    """client_dropout=0.0 must keep the survivors operand out of the
+    round entirely (None -> the original treedef): the dropout
+    machinery is free when disabled."""
+    model, _ = _fed_model("uncompressed")
+    assert model._survivors_for_round(0, np.arange(8)) is None
+
+
+def test_accounting_excludes_dropped_clients():
+    """A dropped client uploads nothing, downloads nothing, and its
+    staleness keeps growing until it completes a round."""
+    model, opt = _fed_model("uncompressed")
+    model.set_fault_schedule(FaultSchedule(drop={1: [3]}))
+    x, y = _problem()
+    ids = np.arange(8, dtype=np.int32)
+    mask = np.ones((8, 4), np.float32)
+
+    model((ids, (x, y), mask))                      # round 0: all live
+    _, _, down1, up1 = model((ids, (x, y), mask))   # round 1: 3 drops
+    assert up1[3] == 0.0 and down1[3] == 0.0
+    live = [c for c in range(8) if c != 3]
+    assert np.all(up1[live] > 0)
+    # staleness: everyone else reset to 1 after the round, client 3 at 2
+    assert model.accountant.stale[3] == 2
+    assert np.all(model.accountant.stale[live] == 1)
+
+    # client 3's next completed round downloads BOTH missed rounds'
+    # changes (>= any single-round download of this round)
+    _, _, down2, up2 = model((ids, (x, y), mask))
+    assert up2[3] > 0
+    assert down2[3] >= down2[live].max()
+
+
+def test_dropout_scales_accounting_change_window():
+    """client_dropout lengthens a client's expected absence, so the
+    accountant's change-bitset window must grow to match — otherwise
+    the stale clip undercharges the download a returning client owes."""
+    base = _fed_model("uncompressed")[0].accountant.changes.maxlen
+    half = _fed_model("uncompressed",
+                      client_dropout=0.5)[0].accountant.changes.maxlen
+    assert half == 2 * base
+
+
+def test_accountant_resume_grows_window_from_wider_config():
+    """client_dropout is deliberately NOT in the checkpoint
+    fingerprint (resuming with a different rate is legitimate), so a
+    resume into a narrower window must grow it to fit the restored
+    rows instead of silently dropping the oldest."""
+    wide = _fed_model("uncompressed", client_dropout=0.5)[0].accountant
+    narrow = _fed_model("uncompressed")[0].accountant
+    for i in range(wide.changes.maxlen):
+        wide.changes.append(np.full(wide.n_words, i, np.uint32))
+    narrow.load_state_dict(wide.state_dict())
+    assert len(narrow.changes) == wide.changes.maxlen
+    np.testing.assert_array_equal(narrow.changes[0],
+                                  np.zeros(narrow.n_words, np.uint32))
+
+
+def test_bernoulli_survivors_deterministic():
+    a = bernoulli_survivors(21, 7, 64, 0.3)
+    b = bernoulli_survivors(21, 7, 64, 0.3)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, bernoulli_survivors(21, 8, 64, 0.3))
+    assert 0 < a.sum() < 64  # some drop, some survive at this size
+    np.testing.assert_array_equal(bernoulli_survivors(21, 7, 64, 0.0),
+                                  np.ones(64, np.float32))
+
+
+# ---------------- crash -> resume bit-equivalence ------------------------
+
+def _run_rounds(model, opt, rounds, data, start=0):
+    x, y = data
+    ids = np.arange(8, dtype=np.int32)
+    mask = np.ones((8, 4), np.float32)
+    for _ in range(start, rounds):
+        model((ids, (x, y), mask))
+        opt.step()
+
+
+def _state_arrays(model):
+    out = {
+        "ps_weights": np.asarray(model.server.ps_weights),
+        "Vvelocity": np.asarray(model.server.Vvelocity),
+        "Verror": np.asarray(model.server.Verror),
+        "round_idx": np.asarray(model.server.round_idx),
+        "errors": np.asarray(model.clients.errors),
+        "velocities": np.asarray(model.clients.velocities),
+    }
+    return out
+
+
+MODES = [
+    ("sketch", dict(k=D, num_rows=2, num_cols=64, num_blocks=1,
+                    error_type="virtual", virtual_momentum=0.9)),
+    ("true_topk", dict(k=3, error_type="virtual", local_momentum=0.5)),
+    ("fedavg", dict(local_batch_size=-1, fedavg_batch_size=2,
+                    virtual_momentum=0.9)),
+]
+
+
+@pytest.mark.parametrize("mode,extra", MODES, ids=[m for m, _ in MODES])
+def test_crash_resume_bit_identical(mode, extra, ckpt_dir):
+    """R rounds straight vs. crash-after-round-k + auto-resume-from-
+    latest: ps_weights, Vvelocity, Verror and client state must be
+    BIT-identical — with random client_dropout active across the crash
+    boundary, so the resumed run must also replay the identical
+    survivor draws."""
+    R, K = 6, 3
+    data = _problem(seed=5)
+    common = dict(client_dropout=0.25, **extra)
+
+    # uninterrupted reference
+    model_a, opt_a = _fed_model(mode, **common)
+    _run_rounds(model_a, opt_a, R, data)
+    want = _state_arrays(model_a)
+
+    # crashing run: rotated checkpoint after every round, injected
+    # crash after round K — the round-K save never happens, exactly
+    # like a real preemption (resume replays the lost round)
+    prefix = os.path.join(ckpt_dir, mode)
+    model_b, opt_b = _fed_model(mode, **common)
+    model_b.set_fault_schedule(FaultSchedule(crash_after=K))
+    x, y = data
+    ids = np.arange(8, dtype=np.int32)
+    mask = np.ones((8, 4), np.float32)
+    with pytest.raises(InjectedFault) as exc:
+        for _ in range(R):
+            model_b((ids, (x, y), mask))
+            opt_b.step()
+            save_rotating(prefix, model_b.server, model_b.clients,
+                          keep_last=2,
+                          scheduler_step=opt_b.param_groups[0].get(
+                              "step", 0),
+                          accountant=model_b.accountant,
+                          prev_change_words=np.asarray(
+                              model_b._prev_change_words),
+                          fingerprint=model_b.checkpoint_fingerprint)
+    assert exc.value.round_idx == K
+
+    # fresh process: auto-resume from the newest rotated checkpoint
+    model_c, opt_c = _fed_model(mode, **common)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None
+    model_c.load_state(ckpt)
+    resumed_at = int(np.asarray(ckpt.server.round_idx))
+    assert resumed_at == K  # last save BEFORE the crash boundary
+    _run_rounds(model_c, opt_c, R, data, start=resumed_at)
+
+    got = _state_arrays(model_c)
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"{mode}: {name} diverged across crash->resume")
+
+
+def test_crash_resume_scanned_matches_unscanned(ckpt_dir):
+    """The scanned (run_rounds) path crashes at the same boundary and
+    resumes to the same bits as the per-round path."""
+    R, K = 5, 2
+    x, y = _problem(seed=6)
+    ids1 = np.arange(8, dtype=np.int32)
+    mask1 = np.ones((8, 4), np.float32)
+    N_ids = np.broadcast_to(ids1, (R, 8)).copy()
+    N_x = np.broadcast_to(x, (R,) + x.shape).copy()
+    N_y = np.broadcast_to(y, (R,) + y.shape).copy()
+    N_mask = np.ones((R, 8, 4), np.float32)
+    lrs = np.full(R, 0.1, np.float32)
+    common = dict(client_dropout=0.25, virtual_momentum=0.9)
+
+    # unscanned reference
+    model_a, opt_a = _fed_model("uncompressed", **common)
+    _run_rounds(model_a, opt_a, R, (x, y))
+    want = np.asarray(model_a.server.ps_weights)
+
+    # scanned run crashes mid-span (span truncation), then a fresh
+    # model resumes the remaining rounds scanned too
+    model_b, _ = _fed_model("uncompressed", **common)
+    model_b.set_fault_schedule(FaultSchedule(crash_after=K))
+    with pytest.raises(InjectedFault):
+        model_b.run_rounds(N_ids, (N_x, N_y), N_mask, lrs)
+    assert int(np.asarray(model_b.server.round_idx)) == K + 1
+    prefix = os.path.join(ckpt_dir, "scan")
+    save_rotating(prefix, model_b.server, model_b.clients,
+                  fingerprint=model_b.checkpoint_fingerprint)
+
+    model_c, _ = _fed_model("uncompressed", **common)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    model_c.load_state(ckpt)
+    done = int(np.asarray(ckpt.server.round_idx))
+    model_c.run_rounds(N_ids[done:], (N_x[done:], N_y[done:]),
+                       N_mask[done:], lrs[done:])
+    np.testing.assert_array_equal(
+        np.asarray(model_c.server.ps_weights), want)
+
+
+@pytest.mark.slow
+def test_dropout_training_still_converges(mesh):
+    """Robustness end-to-end: 30% random dropout slows but does not
+    break convergence (error feedback holds state for absent clients).
+    Marked slow: ~200 jitted rounds."""
+    rng = np.random.RandomState(3)
+    w_true = rng.randn(D).astype(np.float32)
+    x = rng.randn(8, 4, D).astype(np.float32)
+    y = np.einsum("wbd,d->wb", x, w_true).astype(np.float32)
+    _, tr, server, clients = _engine(
+        mesh, "local_topk", k=3, error_type="local")
+    batch = RoundBatch(jnp.arange(8, dtype=jnp.int32),
+                       (jnp.asarray(x), jnp.asarray(y)),
+                       jnp.ones((8, 4)))
+    key = jax.random.PRNGKey(1)
+    for r in range(200):
+        surv = bernoulli_survivors(21, r, 8, 0.3)
+        server, clients, m = tr(
+            server, clients, batch._replace(survivors=jnp.asarray(surv)),
+            0.1, key)
+    np.testing.assert_allclose(np.asarray(server.ps_weights), w_true,
+                               atol=0.3)

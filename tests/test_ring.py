@@ -23,8 +23,10 @@ def make_ring_fn(mesh):
     def shard_fn(q, k, v):
         return ring_attention(q, k, v, axis_name="seq")
 
+    from commefficient_tpu.parallel.compat import shard_map
+
     # sequence axis (dim 2) sharded over the mesh
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(None, None, "seq", None),) * 3,
         out_specs=P(None, None, "seq", None)))
