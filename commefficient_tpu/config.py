@@ -110,9 +110,41 @@ class Config:
     # machinery costs nothing when disabled. Tests inject explicit
     # per-round schedules instead (utils/faults.FaultSchedule).
     client_dropout: float = 0.0
+    # straggler (slow-client) modeling beyond binary dropout: each
+    # sampled client is a straggler with probability straggler_rate;
+    # a straggler draws a WORK FRACTION uniform in
+    # [straggler_min_work, 1) — deterministic in (seed, round), same
+    # replay contract as client_dropout (utils/faults.
+    # straggler_work_fractions). The fraction becomes a per-client
+    # completed-examples budget (completed local SGD steps for
+    # fedavg) inside the jitted round, and aggregation weights by
+    # examples actually processed (FedNova-style) so partial uploads
+    # don't bias the average. A fraction below straggler_cutoff
+    # degrades to the dropout path: state rows bit-untouched,
+    # accounting charges nothing. 0.0 keeps the engine on the
+    # work-free program — the machinery costs nothing when disabled.
+    straggler_rate: float = 0.0
+    straggler_min_work: float = 0.1
+    straggler_cutoff: float = 0.0
     # keep the newest k rotated mid-run checkpoints (utils/checkpoint.
     # save_rotating); older ones are pruned after each atomic save
     keep_checkpoints: int = 3
+    # ALSO prune rotated checkpoints older than this wall-clock age in
+    # hours (0 = age pruning off). Long preemptible-pod runs rotate
+    # slowly near the end of an epoch; age pruning bounds disk growth
+    # by time, not count. The manifest's `latest` entry is never
+    # age-pruned, so resume always has a target.
+    ckpt_max_age_hours: float = 0.0
+    # scanned-path (--scan_rounds) checkpoint cadence in SPANS: with
+    # checkpoint_every on, save a rotated checkpoint every k-th span
+    # boundary (a span is the atomic commit unit — a preemption
+    # mid-span loses back to the last boundary, so 1 bounds the loss
+    # of a kill at any instant to one span). Each save is a full
+    # server+client gather plus a disk write; short spans on a big
+    # model can make every-boundary saving dominate, so raise this to
+    # bound the save rate (preemption loss grows to k spans), or 0 to
+    # keep only the epoch-cadence saves.
+    ckpt_every_spans: int = 1
 
     # parallelization (utils.py:165-180). `port` kept for CLI parity but
     # unused: there is no process-group rendezvous in a single-program
@@ -309,8 +341,27 @@ class Config:
             raise ValueError(
                 f"client_dropout={self.client_dropout} must be in [0, 1) "
                 "(1.0 would drop every client every round)")
+        if not 0.0 <= self.straggler_rate <= 1.0:
+            raise ValueError(
+                f"straggler_rate={self.straggler_rate} must be in [0, 1]")
+        if not 0.0 < self.straggler_min_work <= 1.0:
+            raise ValueError(
+                f"straggler_min_work={self.straggler_min_work} must be "
+                "in (0, 1] (0 would draw clients that do no work at "
+                "all — that's dropout, use client_dropout/cutoff)")
+        if not 0.0 <= self.straggler_cutoff <= 1.0:
+            raise ValueError(
+                f"straggler_cutoff={self.straggler_cutoff} must be in "
+                "[0, 1] (fractions below it degrade to dropout)")
         if self.keep_checkpoints < 1:
             raise ValueError("keep_checkpoints must be >= 1")
+        if self.ckpt_max_age_hours < 0:
+            raise ValueError(
+                "ckpt_max_age_hours must be >= 0 (0 = age pruning off)")
+        if self.ckpt_every_spans < 0:
+            raise ValueError(
+                "ckpt_every_spans must be >= 0 (0 = no span-boundary "
+                "saves, only the epoch cadence)")
         if self.down_k < 0:
             raise ValueError("down_k must be >= 0 (0 = share the upload k)")
         if self.down_k > self.grad_size > 0:
@@ -369,9 +420,30 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                    help="per-round probability a sampled client fails "
                         "to complete the round (survivor-reweighted "
                         "aggregation; Config.client_dropout)")
+    p.add_argument("--straggler_rate", type=float, default=0.0,
+                   help="per-round probability a sampled client is a "
+                        "straggler completing only a fraction of its "
+                        "local work (Config.straggler_rate)")
+    p.add_argument("--straggler_min_work", type=float, default=0.1,
+                   help="lower bound of a straggler's uniform work-"
+                        "fraction draw (Config.straggler_min_work)")
+    p.add_argument("--straggler_cutoff", type=float, default=0.0,
+                   help="work fractions below this degrade to client "
+                        "dropout: no upload, state bit-untouched "
+                        "(Config.straggler_cutoff)")
     p.add_argument("--keep_checkpoints", type=int, default=3,
                    help="keep the newest k rotated mid-run checkpoints "
                         "(utils/checkpoint.save_rotating)")
+    p.add_argument("--ckpt_max_age_hours", type=float, default=0.0,
+                   help="also prune rotated checkpoints older than "
+                        "this wall-clock age in hours; 0 disables "
+                        "(utils/checkpoint.save_rotating)")
+    p.add_argument("--ckpt_every_spans", type=int, default=1,
+                   help="with --scan_rounds and --checkpoint_every: "
+                        "save at every k-th span boundary (1 bounds a "
+                        "mid-span preemption's loss to one span; each "
+                        "save is a full state gather — raise k to "
+                        "bound the save rate; 0 = epoch cadence only)")
 
     p.add_argument("--port", type=int, default=5315)
     p.add_argument("--num_clients", type=int)

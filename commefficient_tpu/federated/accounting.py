@@ -8,10 +8,15 @@ Semantics parity:
     uncompressed/true_topk/fedavg, k for local_topk, r*c for sketch.
     The local_topk count stays the ANALYTIC k, exactly like the
     reference's; above ops/flat.py's TOPK_THRESHOLD_MIN_D the actual
-    transmitted support is k within ~1% sampling noise, so the
-    analytic number remains honest to that tolerance (download bytes
-    are unaffected — they count actual changed weights via the
-    bitset).
+    transmitted support is k within ~1% sampling noise — PLUS any
+    threshold-tie widening (sampled_threshold_mask keeps every
+    coordinate tied at the threshold, so a tie-heavy vector can
+    transmit far more than k). The analytic number remains the billed
+    one, but CommAccountant records the REALIZED nonzero count of each
+    round's aggregate update next to it (realized_nonzeros /
+    max_realized_nonzeros) so a blowout is visible rather than
+    silently under-billed (download bytes are unaffected — they count
+    actual changed weights via the bitset).
   * download bytes per participating client: 4 bytes x number of
     weights that changed since that client last participated
     (reference :239-289), with the same cheap path (single
@@ -128,6 +133,19 @@ class CommAccountant:
         if frozen_count and cfg.mode in ("uncompressed", "true_topk",
                                          "fedavg"):
             self.upload_floats = cfg.grad_size - frozen_count
+        # local_topk blowout observability (module docstring: the
+        # upload charge stays the ANALYTIC k): ops/flat.py's
+        # sampled_threshold_mask can select MORE than k on threshold
+        # ties, and above TOPK_THRESHOLD_MIN_D the count also carries
+        # ~1% sampling noise. record_round therefore keeps the
+        # REALIZED nonzero count of the round's aggregate update
+        # (popcount of its change bitset, one lag behind like the
+        # download math) next to the analytic per-client k, so a tie
+        # blowout is visible — compare realized_nonzeros against
+        # (surviving uploaders x k): the union of W k-sparse uploads
+        # is at most W*k except when ties widen a client's support.
+        self.realized_nonzeros: Optional[int] = None
+        self.max_realized_nonzeros = 0
         # cheap path applies when every client re-downloads everything
         # changed since init (reference fed_aggregator.py:171-177)
         self.cheap = (cfg.num_epochs <= 1 and cfg.local_batch_size == -1)
@@ -186,6 +204,14 @@ class CommAccountant:
 
         upload = np.zeros(self.num_clients)
         upload[participating] = 4.0 * self.upload_floats
+
+        if self.cfg.mode == "local_topk" and prev_changed_words is not None:
+            # realized support of the previous round's aggregate
+            # update, recorded next to the analytic k (__init__ note)
+            self.realized_nonzeros = _popcount(
+                np.asarray(prev_changed_words))
+            self.max_realized_nonzeros = max(self.max_realized_nonzeros,
+                                             self.realized_nonzeros)
         return download, upload
 
     def advance_round(self, participating: np.ndarray,

@@ -48,7 +48,9 @@ from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 from commefficient_tpu.utils.faults import (
     FaultSchedule, InjectedFault, bernoulli_survivors,
+    straggler_work_fractions,
 )
+from commefficient_tpu.utils.retry import with_retries
 
 
 class FedModel:
@@ -174,9 +176,16 @@ class FedModel:
                            schedule: Optional[FaultSchedule]) -> None:
         """Install (or clear, with None) a deterministic fault script:
         scripted client drops override/augment the random
-        client_dropout draw, and crash_after raises InjectedFault once
-        that round has fully completed — the preemption point a
-        checkpoint/resume test (or chaos drill) recovers from."""
+        client_dropout draw, scripted slow fractions compose (min)
+        with the random straggler draw, crash_after raises
+        InjectedFault once that round has fully completed, and
+        crash_in_span kills the span CONTAINING that round before any
+        of it commits — the two preemption points a checkpoint/resume
+        test (or chaos drill) recovers from. Note crash_in_span
+        RE-FIRES if the schedule is still installed after resume
+        (resume restarts the uncommitted round — see FaultSchedule);
+        clear it with set_fault_schedule(None) for a drill that should
+        progress past the crash."""
         self.fault_schedule = schedule
 
     @property
@@ -206,6 +215,59 @@ class FedModel:
             if scripted is not None:
                 mask = scripted if mask is None else mask * scripted
         return mask
+
+    def _work_for_round(self, round_idx: int, client_ids
+                        ) -> Optional[np.ndarray]:
+        """[W] f32 work fractions for one round, or None when nothing
+        slows clients down. Deterministic in (cfg.seed, round_idx),
+        like the survivor draw; scripted FaultSchedule.slow fractions
+        compose with the random draw by elementwise minimum (the
+        slower cause wins)."""
+        W = np.asarray(client_ids).shape[0]
+        work = None
+        if self.cfg.straggler_rate > 0:
+            work = straggler_work_fractions(
+                self.cfg.seed, round_idx, W, self.cfg.straggler_rate,
+                self.cfg.straggler_min_work)
+        if self.fault_schedule is not None:
+            scripted = self.fault_schedule.work_fractions(round_idx, W)
+            if scripted is not None:
+                work = (scripted if work is None
+                        else np.minimum(work, scripted))
+        return work
+
+    def _faults_for_round(self, round_idx: int, client_ids
+                          ) -> Tuple[Optional[np.ndarray],
+                                     Optional[np.ndarray]]:
+        """(survivors, work) for one round, with the straggler cutoff
+        applied: a work fraction below Config.straggler_cutoff
+        DEGRADES to the dropout path — its survivor bit is zeroed (no
+        upload, state rows bit-untouched, accounting charges nothing)
+        and its work entry is reset to the inert 1.0. A work vector
+        that ends up all-ones collapses back to None, so such a round
+        runs the EXACT dropout program an explicitly-dropped client
+        traces — the bit-identity the cutoff contract promises. When
+        work survives, a missing survivor mask is filled with ones:
+        the work program always carries both operands (round.py traces
+        exactly three programs)."""
+        surv = self._survivors_for_round(round_idx, client_ids)
+        work = self._work_for_round(round_idx, client_ids)
+        if work is not None:
+            work = np.asarray(work, np.float32)
+            cutoff = self.cfg.straggler_cutoff
+            if cutoff > 0:
+                below = work < cutoff
+                if below.any():
+                    s = (np.ones(work.shape[0], np.float32)
+                         if surv is None else surv.copy())
+                    s[below] = 0.0
+                    surv = s
+                    work = np.where(below, np.float32(1.0), work)
+            if np.all(work >= 1.0):
+                work = None
+        if work is not None and surv is None:
+            surv = np.ones(work.shape[0], np.float32)
+        return surv, work
 
     # -- reference API surface -------------------------------------------
     def train(self, training: bool):
@@ -307,7 +369,14 @@ class FedModel:
         prev_weights = self.server.ps_weights
 
         this_round = self._rounds_done
-        survivors = self._survivors_for_round(this_round, client_ids)
+        # mid-span preemption, per-round path: each round is its own
+        # span of one — the kill lands while this round's program is
+        # in flight, so NOTHING commits (state, accounting, counter)
+        if (self.fault_schedule is not None
+                and self.fault_schedule.should_crash_in_span(
+                    this_round, 1)):
+            raise InjectedFault(this_round - 1)
+        survivors, work = self._faults_for_round(this_round, client_ids)
 
         P = self._P
         lr = self._lr()
@@ -321,7 +390,9 @@ class FedModel:
                 tuple(self._feed(d) for d in data),
                 self._feed(mask),
                 None if survivors is None
-                else mh.globalize(self.mesh, P(), survivors)),
+                else mh.globalize(self.mesh, P(), survivors),
+                None if work is None
+                else mh.globalize(self.mesh, P(), work)),
             lr, self._key)
         self._rounds_done = this_round + 1
 
@@ -364,17 +435,29 @@ class FedModel:
         happen so later accounted rounds stay correct.
 
         Fault tolerance: per-round survivor masks (client_dropout /
-        FaultSchedule drops) ride into the scanned program as a
-        [N, W] operand; a FaultSchedule crash_after that lands INSIDE
-        the span truncates it — only the rounds up to and including
-        the crash round run (and are accounted), then InjectedFault is
+        FaultSchedule drops) and work fractions (straggler_rate /
+        FaultSchedule slow) ride into the scanned program as [N, W]
+        operands; a FaultSchedule crash_after that lands INSIDE the
+        span truncates it — only the rounds up to and including the
+        crash round run (and are accounted), then InjectedFault is
         raised at the identical boundary the unscanned path crashes
         at, so scanned and per-round runs checkpoint/resume
-        bit-identically."""
+        bit-identically. A crash_in_span landing anywhere in the span
+        instead kills it BEFORE any round commits (the host died while
+        the span's device program was in flight) — resume must come
+        from the last span boundary's checkpoint."""
         lrs = np.asarray(lrs, np.float32)
         ids_host = np.asarray(client_ids)
         n_rounds = ids_host.shape[0]
         first = self._rounds_done
+
+        # mid-span preemption: the whole span is lost — no state, no
+        # accounting, no counter movement; InjectedFault carries the
+        # last round that actually completed (the last span boundary)
+        if (self.fault_schedule is not None
+                and self.fault_schedule.should_crash_in_span(
+                    first, n_rounds)):
+            raise InjectedFault(first - 1)
 
         # span truncation at an injected crash boundary
         crash_at = None
@@ -389,17 +472,24 @@ class FedModel:
             data = tuple(np.asarray(d)[:n_rounds] for d in data)
             mask = np.asarray(mask)[:n_rounds]
 
-        # per-round survivor masks (None when nothing can drop — the
-        # mask-free treedef keeps the dropout-free scanned program)
-        surv_all = None
-        if self.cfg.client_dropout > 0 or self.fault_schedule is not None:
-            rows = [self._survivors_for_round(first + n, ids_host[n])
+        # per-round survivor masks + work fractions (None when nothing
+        # can drop/slow — the operand-free treedefs keep the scanned
+        # program a fault-free build traces). Any round with work
+        # forces the full [N, W] pair: one scanned program per span.
+        surv_all = work_all = None
+        if (self.cfg.client_dropout > 0 or self.cfg.straggler_rate > 0
+                or self.fault_schedule is not None):
+            rows = [self._faults_for_round(first + n, ids_host[n])
                     for n in range(n_rounds)]
-            if any(r is not None for r in rows):
+            ones = np.ones(ids_host.shape[1], np.float32)
+            if any(w is not None for _, w in rows):
+                work_all = np.stack(
+                    [w if w is not None else ones for _, w in rows])
                 surv_all = np.stack(
-                    [r if r is not None
-                     else np.ones(ids_host.shape[1], np.float32)
-                     for r in rows])
+                    [s if s is not None else ones for s, _ in rows])
+            elif any(s is not None for s, _ in rows):
+                surv_all = np.stack(
+                    [s if s is not None else ones for s, _ in rows])
 
         if self.lr_scale_vec is not None:
             # per-parameter LR scaling — same routing _lr() applies on
@@ -407,11 +497,16 @@ class FedModel:
             # the clients' local steps)
             lrs = lrs[:, None] * self.lr_scale_vec[None, :]
         P = self._P
+
         # multi-controller feeding contract matches _call_train: ids
         # global, data/mask rows process-local (leading [N] span axis
-        # unsharded)
-        self.server, self.clients, metrics, bits = (
-            self._train_round.train_rounds(
+        # unsharded). Dispatch is retry-guarded (utils/retry): the
+        # scanned program is FUNCTIONAL — state is only assigned from
+        # its result — so a transient runtime failure (coordinator
+        # blip on a preemptible pod) can safely be retried without
+        # half-mutated state; fatal errors re-raise immediately.
+        def dispatch():
+            return self._train_round.train_rounds(
                 self.server, self.clients,
                 fround.RoundBatch(
                     mh.globalize(self.mesh, P(),
@@ -420,8 +515,13 @@ class FedModel:
                           for d in data),
                     self._feed(mask, leading_axes=1),
                     None if surv_all is None
-                    else mh.globalize(self.mesh, P(), surv_all)),
-                mh.globalize(self.mesh, P(), lrs), self._key))
+                    else mh.globalize(self.mesh, P(), surv_all),
+                    None if work_all is None
+                    else mh.globalize(self.mesh, P(), work_all)),
+                mh.globalize(self.mesh, P(), lrs), self._key)
+
+        self.server, self.clients, metrics, bits = with_retries(
+            dispatch, describe="scanned round span")
         self._rounds_done = first + n_rounds
 
         download = np.zeros(self.num_clients)

@@ -326,7 +326,8 @@ def local_step(flat_grad_fn, weights, batch, mask, error, velocity,
 
 def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
                 lr, key=None,
-                grad_mask: Optional[jax.Array] = None) -> ClientResult:
+                grad_mask: Optional[jax.Array] = None,
+                work: Optional[jax.Array] = None) -> ClientResult:
     """FedAvg: full local SGD over the client's dataset, transmitting
     the dataset-size-weighted weight delta (reference worker_loop
     fedavg branch, fed_worker.py:61-113).
@@ -340,6 +341,17 @@ def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
     fedavg's server update runs at lr=1); `grad_mask` zeroes frozen
     coordinates' local gradients so they neither move nor accrue
     weight decay.
+
+    `work`: optional traced scalar work fraction in (0, 1] — a
+    straggler's COMPLETED-STEPS budget (Config.straggler_*). The
+    client applies only its first ceil(work * steps) local SGD steps
+    (the round deadline lands mid-local-training); later steps still
+    trace (static shapes) but their updates are gated off. The
+    transmitted delta is weighted by examples actually processed —
+    dataset size scaled by completed/total steps — the FedNova-style
+    normalization that keeps heterogeneous work from biasing the
+    average. Loss/metrics are means over completed steps only. None
+    traces the original work-free program.
     """
     B = mask.shape[0]
     inner = B if cfg.fedavg_batch_size == -1 else min(cfg.fedavg_batch_size, B)
@@ -352,11 +364,15 @@ def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
         lambda x: jnp.tile(x, (cfg.num_fedavg_epochs,) + (1,) * (x.ndim - 1)),
         lbatch)
     step_mask = jnp.tile(lmask, (cfg.num_fedavg_epochs, 1))
+    if work is not None:
+        # ceil keeps a surviving straggler on >= 1 step; work=1.0 is
+        # exactly `steps` (below-cutoff fractions never reach here —
+        # the host degraded them to dropout)
+        live_steps = jnp.ceil(work * steps)
 
     def body(carry, xs):
         w, step = carry
         b, m = xs
-        count = jnp.maximum(m.sum(), 1.0)
         loss, metrics, grad = flat_grad_fn(w, b, m)
         # reference computes sum-grad then divides by batch size
         # (fed_worker.py:96-98); our flat_grad_fn already returns the
@@ -366,18 +382,33 @@ def fedavg_step(flat_grad_fn, weights, batch, mask, cfg: Config,
         if grad_mask is not None:
             grad = grad * grad_mask
         decay = cfg.fedavg_lr_decay ** step
-        w = w - grad * lr * decay
-        return (w, step + 1.0), (loss, metrics)
+        if work is None:
+            w = w - grad * lr * decay
+            return (w, step + 1.0), (loss, metrics)
+        live = (step < live_steps).astype(w.dtype)
+        w = w - grad * lr * decay * live
+        return (w, step + 1.0), (loss, metrics, live)
 
     zero = jnp.zeros_like(mask, shape=())
-    (w_final, _), (losses, metrics_seq) = jax.lax.scan(
+    (w_final, _), outs = jax.lax.scan(
         body, (weights + zero, zero), (step_batch, step_mask))
 
-    # metrics averaged over local steps (reference fed_worker.py:102-103)
-    loss = losses.mean()
-    metrics = jax.tree.map(lambda m: m.mean(), metrics_seq)
-
-    count = mask.sum()
+    if work is None:
+        losses, metrics_seq = outs
+        # metrics averaged over local steps (reference fed_worker.py:102-103)
+        loss = losses.mean()
+        metrics = jax.tree.map(lambda m: m.mean(), metrics_seq)
+        count = mask.sum()
+    else:
+        losses, metrics_seq, lives = outs
+        done = lives.sum()
+        denom = jnp.maximum(done, 1.0)
+        loss = (losses * lives).sum() / denom
+        metrics = jax.tree.map(lambda m: (m * lives).sum() / denom,
+                               metrics_seq)
+        # examples actually processed: dataset size scaled by the
+        # completed-step fraction (FedNova-style delta weighting)
+        count = mask.sum() * (done / steps)
     delta = (weights - w_final) * count  # dataset-size weighting (:104-108)
     dummy = jnp.zeros_like(mask, shape=())
     return ClientResult(delta, dummy, dummy, loss, metrics, count)

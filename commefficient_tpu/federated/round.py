@@ -72,11 +72,24 @@ class RoundBatch(NamedTuple):
     (only round_idx advances, so the PRNG stream moves on). None —
     the default, and the only treedef dropout-free callers ever build
     — traces the original mask-free program: dropout machinery is
-    free when disabled."""
+    free when disabled.
+
+    work: optional [num_workers] f32 work fractions in (0, 1] —
+    stragglers (Config.straggler_* / utils.faults). A client with
+    fraction f completes only its first ceil(f * valid) examples
+    (single-step modes) or ceil(f * steps) local SGD steps (fedavg);
+    the aggregate weights by examples actually processed, so partial
+    work doesn't bias the average (FedNova-style). None — the default
+    — traces the work-free program (the surv-only dropout program or
+    the original mask-free one), so straggler machinery is free when
+    disabled. Below-cutoff fractions never appear here: the host
+    (api._faults_for_round) degrades them to dropout and re-normalizes
+    an all-ones work vector back to None."""
     client_ids: jax.Array        # [num_workers] int32
     data: Tuple[jax.Array, ...]  # pytree of [num_workers, B, ...]
     mask: jax.Array              # [num_workers, B] f32 validity
     survivors: Optional[jax.Array] = None  # [num_workers] f32 or None
+    work: Optional[jax.Array] = None       # [num_workers] f32 or None
 
 
 class RoundMetrics(NamedTuple):
@@ -211,7 +224,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
 
     # ---------------- per-shard client phase ----------------------------
     def shard_train(ps_weights, data, mask, err_rows, vel_rows, w_rows,
-                    keys, lr, surv=None):
+                    keys, lr, surv=None, work=None):
         """Runs on one shard: simulate W = num_workers/n_shards clients
         (vmap), locally sum their compressed updates, psum across the
         clients axis (the reference's per-GPU client loop
@@ -222,7 +235,19 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         the psum'd aggregate and its divide-by-total reweighting see
         survivors only. Its per-client loss/metric rows are still
         reported (simulation diagnostics), but num_examples is zeroed
-        so count-weighted consumers exclude it."""
+        so count-weighted consumers exclude it.
+
+        work: optional [W_shard] f32 work fractions (stragglers). For
+        the single-local-step modes the fraction truncates the
+        client's VALIDITY MASK to its first ceil(f * valid) examples
+        before any compute — its mean gradient, its example count,
+        and therefore its weight in the psum'd aggregate all reflect
+        examples actually processed (the divide-by-total below is
+        then exactly the FedNova-style processed-example reweighting).
+        For fedavg the fraction is a completed-steps budget applied
+        inside fedavg_step instead (truncating the dataset would
+        change WHICH examples every epoch sees, not how far local
+        training got)."""
         # Cast the replicated weights to shard-varying before any
         # jax.grad: differentiating w.r.t. an *unvarying* operand under
         # shard_map makes JAX psum the cotangent across shards (correct
@@ -230,7 +255,18 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         # own local gradient, not the cross-client sum).
         ps_weights = pcast(ps_weights, "clients", to="varying")
 
-        def one_client(cdata, cmask, err, vel, w_stale, key):
+        if work is not None and cfg.mode != "fedavg":
+            # completed-examples budget: keep each client's first
+            # ceil(f * valid) valid examples (cumsum walks valid
+            # examples in order, so padding rows stay excluded and a
+            # straggler's partial batch is a prefix — the examples it
+            # got through before the deadline)
+            def budget(m, f):
+                kept = jnp.cumsum(m) <= jnp.ceil(f * m.sum())
+                return m * kept.astype(m.dtype)
+            mask = jax.vmap(budget)(mask, work)
+
+        def one_client(cdata, cmask, err, vel, w_stale, key, cwork=None):
             if cfg.do_topk_down:
                 # download compression: client only receives the top-k
                 # of its weight staleness gap (fed_worker.py:232-247);
@@ -245,7 +281,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             if cfg.mode == "fedavg":
                 res = fclient.fedavg_step(
                     flat_grad, weights, cdata, cmask, cfg, lr, key,
-                    grad_mask=grad_mask)
+                    grad_mask=grad_mask, work=cwork)
             else:
                 res = fclient.local_step(
                     flat_grad, weights, cdata, cmask, err, vel, cfg, key,
@@ -269,8 +305,12 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             dummy = jnp.zeros_like(mask, shape=mask.shape[:1])
             new_err = new_vel = new_w_rows = dummy
         else:
-            results, new_w_rows = jax.vmap(one_client)(
-                data, mask, err_rows, vel_rows, w_rows, keys)
+            if work is not None and cfg.mode == "fedavg":
+                results, new_w_rows = jax.vmap(one_client)(
+                    data, mask, err_rows, vel_rows, w_rows, keys, work)
+            else:
+                results, new_w_rows = jax.vmap(one_client)(
+                    data, mask, err_rows, vel_rows, w_rows, keys)
             if surv is not None:
                 # zero dropped clients' uploads BEFORE the local sum —
                 # the psum'd aggregate and the divide-by-total see
@@ -330,6 +370,21 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         axis_names=frozenset({"clients"}),
     )
 
+    # straggler variant: survivor mask + per-client work fractions.
+    # Work always rides WITH a survivor operand (the host supplies
+    # ones when nothing dropped) so there are exactly three programs:
+    # mask-free, dropout, dropout+stragglers — and the first two stay
+    # bit-identical to their pre-straggler builds.
+    shard_train_work_mapped = shard_map(
+        shard_train, mesh=mesh,
+        in_specs=(P(), P("clients"), P("clients"), P("clients"),
+                  P("clients"), P("clients"), P("clients"), P(),
+                  P("clients"), P("clients")),
+        out_specs=(P(), P(), state_spec, state_spec, state_spec,
+                   P("clients"), P("clients"), P("clients")),
+        axis_names=frozenset({"clients"}),
+    )
+
     # ---------------- full train round ----------------------------------
     def round_step(server: ServerState, clients: ClientState,
                    batch: RoundBatch, lr, key):
@@ -355,7 +410,20 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         )(jnp.arange(num_workers))
 
         surv = batch.survivors
-        if surv is None:
+        work = batch.work
+        if work is not None:
+            # stragglers active: the work program always carries a
+            # survivor operand too (below-cutoff degradation composes
+            # the two), so substitute ones when nothing dropped
+            surv = (jnp.ones(num_workers, jnp.float32) if surv is None
+                    else surv.astype(jnp.float32))
+            (transmit, total, new_err, new_vel, new_w, losses, metrics,
+             counts) = shard_train_work_mapped(
+                server.ps_weights, batch.data, batch.mask,
+                err_rows, vel_rows, w_rows, client_keys, lr, surv,
+                work.astype(jnp.float32))
+            alive = surv.sum() > 0
+        elif surv is None:
             (transmit, total, new_err, new_vel, new_w, losses, metrics,
              counts) = shard_train_mapped(
                 server.ps_weights, batch.data, batch.mask,
@@ -374,7 +442,11 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
 
         # mean over the global batch (reference fed_aggregator.py:332):
         # with dropout, `total` already counts survivor examples only,
-        # so the mean reweights by survivor count automatically
+        # so the mean reweights by survivor count automatically; with
+        # stragglers, each transmit was scaled by (and `total` counts)
+        # examples ACTUALLY processed, so heterogeneous work fractions
+        # normalize out FedNova-style — a half-work client carries
+        # half weight, not a half-magnitude bias
         gradient = transmit / jnp.maximum(total, 1.0)
 
         # server aggregation + decompression

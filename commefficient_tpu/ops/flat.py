@@ -94,7 +94,21 @@ def sampled_threshold_mask(v: jax.Array, k: int) -> jax.Array:
     keep every coordinate at or above it. Coordinates the caller wants
     excluded (e.g. a padding tail) must already be zero — zeros sort
     last, so they dilute the sample and the selection identically and
-    the quantile math stays exact."""
+    the quantile math stays exact.
+
+    TIE CAVEAT: the `sq >= thr` select keeps EVERY coordinate whose
+    squared magnitude ties the estimated threshold, so the realized
+    count can exceed k by the tie multiplicity on top of the ~1%
+    sampling noise. Real gradients have measure-zero ties, but
+    structured inputs (quantized values, repeated embeddings, adv
+    synthetic tests) can tie arbitrarily many coordinates — a
+    degenerate vector with one repeated magnitude selects ALL its
+    nonzeros. Error feedback keeps the math correct either way (the
+    selection is a superset of intent), but the WIRE cost grows with
+    the realized support, which is why local_topk accounting records
+    the realized nonzero count next to the analytic k
+    (federated/accounting.CommAccountant.realized_nonzeros) — a tie
+    blowout shows up there instead of silently under-billing."""
     d = v.shape[0]
     k = min(k, d)
     sq = v * v

@@ -250,7 +250,22 @@ class CSVec:
         `dense` is the already-materialized dense form of the sparse
         vector, if the caller has one in hand (the server's
         error-feedback step does); without it the dense route pays one
-        extra O(k) scatter to build it."""
+        extra O(k) scatter to build it.
+
+        BACKEND-DISPATCH CAVEAT: unlike this module's other route
+        gates (THRESHOLD_DECODE_MIN_D, DECODE_MATERIALIZE_LIMIT),
+        which are d-based so a geometry has ONE semantics everywhere,
+        this gate consults `jax.default_backend()` at TRACE time. The
+        two routes are mathematically identical by sketch linearity,
+        but floating-point summation ORDER differs (scatter-add
+        accumulation vs. dense rotation reduction), so at large r*k a
+        CPU trace and a TPU trace of the same geometry can produce
+        sketch tables differing in final-ulp rounding. Cross-backend
+        bitwise-equality comparisons (e.g. a CPU golden against a TPU
+        run) must therefore pin the route — pass `dense` explicitly or
+        compare within one backend; same-backend runs (all tests, all
+        multihost bit-equality proofs) are unaffected because the
+        dispatch is deterministic per backend."""
         use_dense = (self.r * int(indices.shape[0]) > 1_000_000
                      and jax.default_backend() != "cpu")
         if not use_dense:

@@ -48,13 +48,29 @@ _initialized = False
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
-               local_device_ids: Optional[Sequence[int]] = None) -> None:
+               local_device_ids: Optional[Sequence[int]] = None,
+               connect_timeout_s: float = 300.0,
+               connect_retries: int = 3,
+               retry_sleep=None) -> None:
     """``jax.distributed.initialize``, before any backend use.
 
     On TPU pods every argument is auto-detected from the TPU
     environment, so a bare ``initialize()`` suffices; elsewhere
     (CPU/GPU grids, the emulated two-process CPU mode the tests use)
-    pass the coordinator and process grid explicitly."""
+    pass the coordinator and process grid explicitly.
+
+    The coordinator rendezvous is the single most failure-prone moment
+    of a preemptible-pod launch (a neighbor host restarting a few
+    seconds late looks like a dead coordinator), so the one blocking
+    attempt is replaced by a bounded connect policy: each attempt is
+    capped at ``connect_timeout_s`` (passed through to jax's
+    ``initialization_timeout`` where the installed version supports
+    it), and a TRANSIENT failure — connection refused/reset, gRPC
+    DEADLINE_EXCEEDED/UNAVAILABLE (utils/retry.is_transient_error) —
+    is retried up to ``connect_retries`` more times with exponential
+    backoff, each retry logged through utils/logging. Fatal errors
+    (bad arguments, mismatched grids) raise immediately.
+    ``retry_sleep`` overrides the backoff sleep (tests)."""
     global _initialized
     if _initialized:
         # idempotent: drivers and libraries may both ask for the
@@ -69,7 +85,38 @@ def initialize(coordinator_address: Optional[str] = None,
         kw["process_id"] = process_id
     if local_device_ids is not None:
         kw["local_device_ids"] = local_device_ids
-    jax.distributed.initialize(**kw)
+    import inspect
+    try:
+        params = inspect.signature(jax.distributed.initialize).parameters
+    except (TypeError, ValueError):  # C-accelerated / wrapped callable
+        params = {}
+    if "initialization_timeout" in params:
+        kw["initialization_timeout"] = int(connect_timeout_s)
+
+    from commefficient_tpu.utils.retry import with_retries
+
+    def attempt():
+        try:
+            jax.distributed.initialize(**kw)
+        except Exception:
+            # jax assigns its global client (and rank 0's coordination
+            # service) BEFORE connect(), so a failed connect leaves
+            # half-initialized state that would make the next call
+            # raise 'initialize should only be called once' — a fatal-
+            # looking error masking the real timeout. Tear it down
+            # best-effort so the retry is a genuine fresh attempt.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            raise
+
+    retry_kw = {} if retry_sleep is None else {"sleep": retry_sleep}
+    with_retries(attempt,
+                 retries=connect_retries,
+                 describe="jax.distributed.initialize "
+                          f"({coordinator_address or 'auto-detected'})",
+                 **retry_kw)
     _initialized = True
 
 

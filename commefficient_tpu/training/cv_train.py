@@ -38,7 +38,9 @@ from commefficient_tpu.data import (
 from commefficient_tpu.federated.api import FedModel, FedOptimizer
 from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
-from commefficient_tpu.training.scanloop import run_scanned_rounds
+from commefficient_tpu.training.scanloop import (
+    make_span_checkpoint, run_scanned_rounds,
+)
 from commefficient_tpu.utils.checkpoint import (
     latest_checkpoint_path, load_checkpoint, save_final, save_rotating,
     transfer_for_finetune,
@@ -190,6 +192,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             # (training/scanloop.py)
             taken = 0
 
+
             def stream():
                 nonlocal taken
                 for client_ids, data, mask in epoch_stream:
@@ -219,7 +222,11 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             run_scanned_rounds(
                 model, stream(),
                 cfg.scan_span if cfg.scan_span > 0 else epoch_rounds,
-                scan_emit, on_comm, on_flush=on_flush)
+                scan_emit, on_comm, on_flush=on_flush,
+                # span-boundary saves bound a mid-span preemption's
+                # loss to ckpt_every_spans spans, not one epoch
+                checkpoint=make_span_checkpoint(
+                    _ckpt_path(cfg), model, cfg, lr_scheduler))
             rounds_done += taken
         else:
             # metrics materialize with a ONE-ROUND lag: float()ing the
@@ -304,6 +311,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             path = save_rotating(
                 _ckpt_path(cfg), model.server, model.clients,
                 keep_last=cfg.keep_checkpoints,
+                max_age_hours=cfg.ckpt_max_age_hours,
                 scheduler_step=lr_scheduler.step_count,
                 accountant=model.accountant,
                 prev_change_words=model._prev_change_words,
@@ -458,6 +466,7 @@ def main(argv=None) -> bool:
         # fixed-name artifact the finetune path loads, in one gather
         path = save_final(_ckpt_path(cfg), model.server, model.clients,
                           keep_last=cfg.keep_checkpoints,
+                          max_age_hours=cfg.ckpt_max_age_hours,
                           scheduler_step=lr_scheduler.step_count,
                           accountant=model.accountant,
                           prev_change_words=model._prev_change_words,

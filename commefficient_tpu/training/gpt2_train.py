@@ -41,7 +41,9 @@ from commefficient_tpu.models.gpt2 import (
 from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 from commefficient_tpu.parallel.tp import tp_loss
-from commefficient_tpu.training.scanloop import run_scanned_rounds
+from commefficient_tpu.training.scanloop import (
+    make_span_checkpoint, run_scanned_rounds,
+)
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.utils.checkpoint import (
     latest_checkpoint_path, load_checkpoint, save_checkpoint,
@@ -231,7 +233,11 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 cfg.scan_span if cfg.scan_span > 0 else spe,
                 lambda tag, l_, lm_, mc_: emit(
                     (tag[0], tag[1], l_, lm_, mc_)),
-                on_comm)
+                on_comm,
+                # span-boundary saves bound a mid-span preemption's
+                # loss to ckpt_every_spans spans, not one epoch
+                checkpoint=make_span_checkpoint(
+                    ckpt_path, model, cfg, lr_scheduler))
         else:
             for client_ids, data, mask in epoch_stream:
                 if batch_idx - epoch * spe >= spe * frac:
@@ -272,6 +278,7 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
             written = save_rotating(
                 ckpt_path, model.server, model.clients,
                 keep_last=cfg.keep_checkpoints,
+                max_age_hours=cfg.ckpt_max_age_hours,
                 scheduler_step=lr_scheduler.step_count,
                 accountant=model.accountant,
                 prev_change_words=model._prev_change_words,
@@ -491,6 +498,7 @@ def main(argv=None) -> bool:
             # fixed-name artifact, in one collective gather
             save_final(ckpt_path, model.server, model.clients,
                        keep_last=cfg.keep_checkpoints,
+                       max_age_hours=cfg.ckpt_max_age_hours,
                        scheduler_step=lr_scheduler.step_count,
                        accountant=model.accountant,
                        prev_change_words=model._prev_change_words,

@@ -19,7 +19,8 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
                        emit: Callable[..., bool],
                        on_comm: Optional[Callable[[np.ndarray, np.ndarray],
                                                   None]] = None,
-                       on_flush: Optional[Callable[[int], None]] = None
+                       on_flush: Optional[Callable[[int], None]] = None,
+                       checkpoint: Optional[Callable[[], None]] = None
                        ) -> bool:
     """Drive scanned spans over `stream`, which yields
     (tag, client_ids, data_tuple, mask, lr) per round — the caller owns
@@ -29,10 +30,20 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
     device program has returned (per-round wall-time attribution — a
     scanned span has no per-round boundaries, so callers amortize),
     then on_comm(download, upload) once (host accounting totals), then
-    emit(tag, *per_round_metric_rows) once per round IN ORDER. emit
-    returning False aborts immediately (the remaining rounds of the
-    span are neither emitted nor logged — matching the unscanned loop,
-    which stops at the first bad round).
+    checkpoint() once, then emit(tag, *per_round_metric_rows) once per
+    round IN ORDER. emit returning False aborts immediately (the
+    remaining rounds of the span are neither emitted nor logged —
+    matching the unscanned loop, which stops at the first bad round).
+
+    `checkpoint` is the mid-span-preemption survival hook: a span is
+    the atomic commit unit of scanned training (a preemption while a
+    span's device program is in flight loses everything since the last
+    span boundary — FedModel.run_rounds, FaultSchedule.crash_in_span),
+    so checkpointing at every boundary — AFTER the span's state and
+    accounting have committed, BEFORE emits that might abort — bounds
+    the loss of a kill at any instant to one span. Callers pass a
+    closure over utils/checkpoint.save_rotating; tests prove resume
+    from the hook's checkpoint is bit-exact to the uninterrupted run.
 
     Returns True if every emit succeeded, False on abort.
     """
@@ -49,6 +60,8 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
             on_flush(len(ids))
         if on_comm is not None:
             on_comm(down, up)
+        if checkpoint is not None:
+            checkpoint()
         for n in range(len(ids)):
             if not emit(tags[n], *[m[n] for m in metric_rows]):
                 return False
@@ -67,3 +80,39 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
     if ids:
         return flush()
     return True
+
+
+def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
+    """Build the drivers' shared `checkpoint` hook for
+    run_scanned_rounds: a rotated save (utils/checkpoint.save_rotating)
+    at every cfg.ckpt_every_spans-th span boundary. Returns None when
+    span-boundary saving is off — checkpointing disabled entirely
+    (checkpoint_every=0) or cadence 0 (epoch-cadence saves only).
+
+    Each save is a full server+client state gather plus a disk write,
+    which is why the cadence is a knob: 1 (the default) bounds a
+    mid-span preemption's loss to one span, larger values trade
+    recovery granularity for save rate on big models."""
+    if not (cfg.checkpoint_every and cfg.ckpt_every_spans):
+        return None
+    from commefficient_tpu.parallel import multihost as mh
+    from commefficient_tpu.utils.checkpoint import save_rotating
+
+    spans_done = [0]
+
+    def span_checkpoint():
+        spans_done[0] += 1
+        if spans_done[0] % cfg.ckpt_every_spans:
+            return
+        path = save_rotating(
+            prefix, model.server, model.clients,
+            keep_last=cfg.keep_checkpoints,
+            max_age_hours=cfg.ckpt_max_age_hours,
+            scheduler_step=lr_scheduler.step_count,
+            accountant=model.accountant,
+            prev_change_words=model._prev_change_words,
+            fingerprint=model.checkpoint_fingerprint)
+        if mh.is_coordinator():
+            print(f"checkpointed to {path}")
+
+    return span_checkpoint

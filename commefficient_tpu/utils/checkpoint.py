@@ -254,7 +254,8 @@ def _atomic_write_text(path: str, text: str) -> None:
 
 def save_rotating(prefix: str, server: ServerState,
                   clients: Optional[ClientState] = None,
-                  keep_last: int = 3, **kw) -> str:
+                  keep_last: int = 3, max_age_hours: float = 0.0,
+                  **kw) -> str:
     """Atomic round-stamped save + `<prefix>.latest` manifest update +
     keep-last-k pruning. Returns the written path.
 
@@ -265,7 +266,14 @@ def save_rotating(prefix: str, server: ServerState,
     file. Pruning removes only files the rotation itself wrote (they
     must match the stamp pattern), never a legacy fixed-name
     checkpoint. Collective in multi-controller runs (save_checkpoint
-    gathers); only the coordinator touches the filesystem."""
+    gathers); only the coordinator touches the filesystem.
+
+    max_age_hours > 0 ALSO prunes kept entries older than that
+    wall-clock age (file mtime) — keep-last-k bounds disk by count,
+    age pruning bounds it by time for long slow-rotating pod runs.
+    The just-written `latest` entry is exempt (its mtime is fresh
+    anyway), so the manifest can never dangle: every basename it
+    lists — `latest` included — names a file that survived pruning."""
     round_idx = int(np.asarray(mh.gather_host(server.round_idx)))
     path = f"{prefix}-r{round_idx:08d}.npz"
     save_checkpoint(path, server, clients, **kw)
@@ -286,6 +294,22 @@ def save_rotating(prefix: str, server: ServerState,
         history = [h for h in history if _round_stamp(h) <= round_idx]
         history = [base] + [h for h in history if h != base]
         keep = history[:max(keep_last, 1)]
+        if max_age_hours > 0:
+            # age filter BEFORE the manifest write: the history must
+            # only ever list files the prune below leaves on disk.
+            # keep[0] is the file written moments ago — never pruned,
+            # so `latest` always resolves.
+            import time
+            cutoff_ts = time.time() - max_age_hours * 3600.0
+            ckpt_dir = os.path.dirname(prefix) or "."
+
+            def fresh(basename: str) -> bool:
+                try:
+                    return (os.path.getmtime(
+                        os.path.join(ckpt_dir, basename)) >= cutoff_ts)
+                except OSError:
+                    return False
+            keep = [keep[0]] + [h for h in keep[1:] if fresh(h)]
         _atomic_write_text(mpath, json.dumps(
             {"latest": base, "history": keep}, indent=2))
         # prune every stamped file NOT in the kept history (not just
@@ -306,7 +330,8 @@ def save_rotating(prefix: str, server: ServerState,
 
 def save_final(prefix: str, server: ServerState,
                clients: Optional[ClientState] = None,
-               keep_last: int = 3, **kw) -> str:
+               keep_last: int = 3, max_age_hours: float = 0.0,
+               **kw) -> str:
     """End-of-run save: ONE collective gather, two artifacts — the
     rotated stamped checkpoint (+ manifest, so a later --resume sees
     this final state) and the legacy fixed `<prefix>.npz` the
@@ -315,7 +340,8 @@ def save_final(prefix: str, server: ServerState,
     (which would double a multi-GB device->host transfer at
     shutdown). Returns the fixed-name path."""
     stamped = save_rotating(prefix, server, clients,
-                            keep_last=keep_last, **kw)
+                            keep_last=keep_last,
+                            max_age_hours=max_age_hours, **kw)
     fixed = prefix if prefix.endswith(".npz") else prefix + ".npz"
     if mh.is_coordinator():
         tmp = fixed + ".tmp"

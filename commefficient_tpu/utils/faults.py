@@ -1,22 +1,31 @@
 """Deterministic fault injection for federated rounds.
 
-The round engine models two failure classes that real federated
+The round engine models the failure classes that real federated
 deployments (FetchSGD's target setting) and preemptible TPU pods
 exhibit and the reference never does:
 
   * client dropout — a sampled client fails to complete a round: its
     upload is excluded from aggregation, its persistent state rows are
     bit-untouched, and accounting charges it nothing;
+  * stragglers — a sampled client is SLOW rather than dead: it
+    finishes only a fraction of its local work before the round
+    deadline. The work fraction becomes a per-client completed-
+    examples (single-step modes) / completed-steps (fedavg) budget
+    inside the jitted round, aggregation weights by examples actually
+    processed (FedNova-style), and a fraction below
+    `Config.straggler_cutoff` degrades to the dropout path;
   * run preemption — the whole training process dies between rounds
-    and must resume from the newest checkpoint bit-exactly.
+    (`crash_after`) or while a scanned span is still in flight
+    (`crash_in_span`, losing every round since the last span
+    boundary) and must resume from the newest checkpoint bit-exactly.
 
-Both are driven from this module so tests can script failures
-deterministically: `FaultSchedule` says exactly which clients drop in
-which round and after which round the run "crashes" (a raised
-`InjectedFault`), and `bernoulli_survivors` is the production-path
-random dropout draw (`Config.client_dropout`), a pure function of
-(seed, round) so a resumed run replays the identical survivor
-sequence.
+All are driven from this module so tests can script failures
+deterministically: `FaultSchedule` says exactly which clients drop or
+slow down in which round and where the run "crashes" (a raised
+`InjectedFault`), while `bernoulli_survivors` and
+`straggler_work_fractions` are the production-path random draws
+(`Config.client_dropout` / `Config.straggler_*`), pure functions of
+(seed, round) so a resumed run replays the identical fault sequence.
 
 The schedule is consumed host-side by `FedModel` (federated/api.py):
 the survivor mask it produces is passed into the jitted round as data
@@ -60,6 +69,27 @@ def bernoulli_survivors(seed: int, round_idx: int, num_workers: int,
     return (rng.random(num_workers) >= dropout).astype(np.float32)
 
 
+def straggler_work_fractions(seed: int, round_idx: int, num_workers: int,
+                             rate: float,
+                             min_work: float = 0.1) -> np.ndarray:
+    """The production straggler draw: [num_workers] f32 work fractions
+    in (0, 1]. Each participant slot is a straggler with probability
+    `rate`; a straggler's fraction is uniform in [min_work, 1),
+    everyone else works at 1.0 (full round).
+
+    Same replay contract as `bernoulli_survivors`: a pure function of
+    (seed, round_idx) with its own counter-based generator (a distinct
+    domain tag, so the straggler stream never aliases the dropout
+    stream), required for crash->resume bit-equivalence."""
+    if rate <= 0.0:
+        return np.ones(num_workers, np.float32)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed), 0x51044, int(round_idx)]))
+    is_straggler = rng.random(num_workers) < rate
+    frac = min_work + (1.0 - min_work) * rng.random(num_workers)
+    return np.where(is_straggler, frac, 1.0).astype(np.float32)
+
+
 @dataclass(frozen=True)
 class FaultSchedule:
     """A deterministic script of failures for one training run.
@@ -72,15 +102,41 @@ class FaultSchedule:
                  than identity (e.g. "slot 0 of round 2").
     drop_all:    rounds where every sampled client drops (the
                  zero-survivor no-op case).
+    slow:        {round_idx: {participant SLOT: work fraction}} —
+                 scripted stragglers. A listed slot completes only
+                 that fraction of its local work (examples for
+                 single-step modes, local SGD steps for fedavg);
+                 unlisted slots work at 1.0. Composes with the random
+                 Config.straggler_rate draw by elementwise minimum.
     crash_after: raise InjectedFault once the given round has fully
                  completed (state updated, accounting recorded) — the
                  preemption point a checkpoint/resume test recovers
                  from. None = never crash.
+    crash_in_span: raise InjectedFault while the span CONTAINING this
+                 round is still in flight — before any round of that
+                 span commits state or accounting. Models a preemption
+                 that kills the host mid-device-program: everything
+                 since the last span boundary is lost, and resume must
+                 land bit-exactly on the last flushed span. On the
+                 per-round path each round is its own span of one.
+                 UNLIKE crash_after (which fires only after its round
+                 has committed, so a resumed run starting past it
+                 never re-triggers), crash_in_span fires BEFORE its
+                 round commits — a resumed run that re-installs the
+                 same schedule restarts at that round and crashes at
+                 the identical point again, forever. That models
+                 repeated preemption of the same span; a chaos drill
+                 that should make progress after resume must clear or
+                 advance the schedule (set_fault_schedule(None)) once
+                 the crash has been exercised, the way the tests
+                 resume with a fresh, schedule-free model.
     """
     drop: Mapping[int, Sequence[int]] = field(default_factory=dict)
     drop_slots: Mapping[int, Sequence[int]] = field(default_factory=dict)
     drop_all: Sequence[int] = ()
+    slow: Mapping[int, Mapping[int, float]] = field(default_factory=dict)
     crash_after: Optional[int] = None
+    crash_in_span: Optional[int] = None
 
     def survival_mask(self, round_idx: int,
                       client_ids: np.ndarray) -> Optional[np.ndarray]:
@@ -102,6 +158,42 @@ class FaultSchedule:
             mask[np.asarray(slots, np.int64)] = 0.0
         return mask
 
+    def work_fractions(self, round_idx: int,
+                       num_slots: int) -> Optional[np.ndarray]:
+        """[W] f32 scripted work fractions for this round, or None
+        when the schedule lists no straggler for it (round runs at
+        full work). Fractions must lie in (0, 1] — the work-fraction
+        domain the round engine is built for: zero work is NOT a
+        straggler (ceil(0 * valid) would process nothing yet still
+        scatter fresh error-feedback rows back), it is a dropped
+        client — script it with drop/drop_slots, or give it a small
+        fraction under Config.straggler_cutoff to take the degradation
+        path."""
+        spec = self.slow.get(int(round_idx))
+        if spec is None:
+            return None
+        out = np.ones(num_slots, np.float32)
+        for slot, frac in spec.items():
+            frac = float(frac)
+            if not 0.0 < frac <= 1.0:
+                raise ValueError(
+                    f"FaultSchedule.slow[{round_idx}][{slot}] = {frac} "
+                    "is outside the (0, 1] work-fraction domain; for "
+                    "zero work use drop/drop_slots (dropout), or a "
+                    "small fraction below Config.straggler_cutoff")
+            out[int(slot)] = frac
+        return out
+
     def should_crash(self, round_idx: int) -> bool:
         return (self.crash_after is not None
                 and int(round_idx) == int(self.crash_after))
+
+    def should_crash_in_span(self, first_round: int,
+                             n_rounds: int) -> bool:
+        """True when crash_in_span lands inside [first_round,
+        first_round + n_rounds): the span must die before any of its
+        rounds commit (FedModel raises InjectedFault(first_round - 1),
+        the last round that actually completed)."""
+        return (self.crash_in_span is not None
+                and int(first_round) <= int(self.crash_in_span)
+                < int(first_round) + int(n_rounds))

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# The repo's tier-1 verify recipe, exactly as ROADMAP.md specifies it —
+# committed so the command is code, not tribal knowledge. Run from the
+# repo root:
+#
+#   bash scripts/tier1.sh
+#
+# Exit code is pytest's; the DOTS_PASSED line is the driver's pass
+# counter (count of '.' progress dots in the captured log).
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -204,3 +204,39 @@ def test_accounting_identical_with_and_without_native():
     for (dn, un), (df, uf) in zip(native_out, fallback_out):
         np.testing.assert_array_equal(dn, df)
         np.testing.assert_array_equal(un, uf)
+
+
+def test_local_topk_realized_nonzeros_recorded():
+    """local_topk bills the ANALYTIC k, but the realized support of
+    each round's aggregate update is recorded next to it (ops/flat.py
+    sampled_threshold_mask can select >k on threshold ties) so a
+    blowout is visible instead of silently under-billed."""
+    acct = CommAccountant(cfg_for(mode="local_topk", k=5,
+                                  error_type="local"), num_clients=4)
+    assert acct.realized_nonzeros is None  # nothing observed yet
+    acct.record_round(np.array([0, 1]), None)
+    assert acct.realized_nonzeros is None  # first round: no prev bits
+
+    # a tie blowout: 17 realized nonzeros against analytic k=5
+    bits = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.arange(17)].set(1.0)))
+    _, up = acct.record_round(np.array([0, 1]), bits)
+    assert up[0] == 4.0 * 5  # billing stays analytic
+    assert acct.realized_nonzeros == 17
+    assert acct.max_realized_nonzeros == 17
+
+    # max holds the high-water mark across rounds
+    small = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.arange(3)].set(1.0)))
+    acct.record_round(np.array([0, 1]), small)
+    assert acct.realized_nonzeros == 3
+    assert acct.max_realized_nonzeros == 17
+
+
+def test_realized_nonzeros_untracked_off_local_topk():
+    """Other modes skip the extra popcount: the counter stays None."""
+    acct = CommAccountant(cfg_for(), num_clients=4)
+    acct.record_round(np.array([0, 1]), None)
+    bits = np.asarray(pack_change_bits(jnp.ones(64)))
+    acct.record_round(np.array([0, 1]), bits)
+    assert acct.realized_nonzeros is None

@@ -1,12 +1,15 @@
 """Preemption-safe checkpointing: atomic writes, keep-last-k rotation
-with a `latest` manifest, config fingerprints, and the actionable
-mismatch error (ISSUE 1 satellites)."""
+with a `latest` manifest, wall-clock age GC, config fingerprints, and
+the actionable mismatch error (ISSUE 1 satellites + ISSUE 2 age GC)."""
 import json
 import os
+import time
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.faults
 
 from commefficient_tpu.config import Config
 from commefficient_tpu.federated.api import FedModel, FedOptimizer
@@ -141,6 +144,83 @@ def test_save_final_fixed_name_and_manifest_agree(ckpt_dir):
     assert int(resumed.server.round_idx) == 5
     np.testing.assert_array_equal(np.asarray(resumed.server.ps_weights),
                                   2.0)
+
+
+# ---------------- wall-clock age GC --------------------------------------
+
+def _backdate(ckpt_dir, basename, hours):
+    past = time.time() - hours * 3600.0
+    os.utime(os.path.join(ckpt_dir, basename), (past, past))
+
+
+def test_age_pruning_removes_backdated_stamps(ckpt_dir):
+    """max_age_hours prunes kept entries whose mtime is older than the
+    cutoff — keep-last-k bounds disk by count, age bounds it by time —
+    and the manifest lists exactly the files that survived."""
+    prefix = os.path.join(ckpt_dir, "run")
+    for r in range(3):
+        save_rotating(prefix, _server(round_idx=r), keep_last=5)
+    # rounds 0 and 1 were written "10 hours ago"
+    _backdate(ckpt_dir, "run-r00000000.npz", 10)
+    _backdate(ckpt_dir, "run-r00000001.npz", 10)
+    save_rotating(prefix, _server(round_idx=3), keep_last=5,
+                  max_age_hours=1.0)
+    stamped = sorted(f for f in os.listdir(ckpt_dir)
+                     if f.startswith("run-r") and f.endswith(".npz"))
+    assert stamped == ["run-r00000002.npz", "run-r00000003.npz"]
+    with open(prefix + ".latest") as f:
+        manifest = json.load(f)
+    assert manifest["history"] == ["run-r00000003.npz",
+                                   "run-r00000002.npz"]
+    # every listed basename exists on disk (the manifest never lists a
+    # pruned file)
+    for h in manifest["history"]:
+        assert os.path.exists(os.path.join(ckpt_dir, h))
+
+
+def test_age_pruning_never_dangles_latest(ckpt_dir):
+    """Even a cutoff that would prune EVERYTHING by age exempts the
+    just-written checkpoint: `latest` always names a live file and
+    resume always has a target."""
+    prefix = os.path.join(ckpt_dir, "run")
+    save_rotating(prefix, _server(round_idx=0), keep_last=3)
+    _backdate(ckpt_dir, "run-r00000000.npz", 100)
+    save_rotating(prefix, _server(round_idx=1, fill=4.0), keep_last=3,
+                  max_age_hours=1e-9)
+    with open(prefix + ".latest") as f:
+        manifest = json.load(f)
+    assert manifest["latest"] == "run-r00000001.npz"
+    assert manifest["history"] == ["run-r00000001.npz"]
+    resumed = load_latest(prefix)
+    assert int(resumed.server.round_idx) == 1
+    np.testing.assert_array_equal(np.asarray(resumed.server.ps_weights),
+                                  4.0)
+
+
+def test_age_pruning_off_by_default(ckpt_dir):
+    """max_age_hours=0 (the default) never age-prunes: backdated files
+    inside keep-last-k survive."""
+    prefix = os.path.join(ckpt_dir, "run")
+    save_rotating(prefix, _server(round_idx=0), keep_last=3)
+    _backdate(ckpt_dir, "run-r00000000.npz", 1000)
+    save_rotating(prefix, _server(round_idx=1), keep_last=3)
+    stamped = sorted(f for f in os.listdir(ckpt_dir)
+                     if f.startswith("run-r") and f.endswith(".npz"))
+    assert stamped == ["run-r00000000.npz", "run-r00000001.npz"]
+
+
+def test_save_final_forwards_age_pruning(ckpt_dir):
+    """save_final threads max_age_hours through to the rotation, so
+    the end-of-run save also GCs an old pod run's stale stamps."""
+    prefix = os.path.join(ckpt_dir, "fin")
+    save_rotating(prefix, _server(round_idx=0), keep_last=5)
+    _backdate(ckpt_dir, "fin-r00000000.npz", 10)
+    save_final(prefix, _server(round_idx=2, fill=2.0), keep_last=5,
+               max_age_hours=1.0)
+    stamped = sorted(f for f in os.listdir(ckpt_dir)
+                     if f.startswith("fin-r") and f.endswith(".npz"))
+    assert stamped == ["fin-r00000002.npz"]
+    assert int(load_latest(prefix).server.round_idx) == 2
 
 
 def test_load_latest_legacy_fixed_name_fallback(ckpt_dir):

@@ -32,6 +32,21 @@ def test_smoke_uncompressed_scan_rounds(tmp_path):
     assert run_main(tmp_path, "--mode", "uncompressed", "--scan_rounds")
 
 
+def test_scan_span_checkpoint_cadence(tmp_path):
+    """--ckpt_every_spans thins the span-boundary saves: with spans of
+    2 rounds and cadence 2, only every SECOND boundary (rounds 4, 8)
+    writes a checkpoint — the epoch-cadence user isn't silently
+    upgraded to a full gather per span."""
+    import os
+    ck = str(tmp_path / "ck")
+    assert run_main(tmp_path, "--mode", "uncompressed", "--scan_rounds",
+                    "--scan_span", "2", "--num_epochs", "0.25",
+                    "--checkpoint_every", "1", "--ckpt_every_spans", "2",
+                    "--checkpoint_path", ck, "--straggler_rate", "0.3")
+    stamped = sorted(f for f in os.listdir(ck) if f.endswith(".npz"))
+    assert stamped == ["ResNet9-r00000004.npz", "ResNet9-r00000008.npz"]
+
+
 def test_smoke_multislice(tmp_path):
     # --num_slices 2: the round runs on the slice-major (emulated DCN)
     # device layout end to end (parallel/mesh.py)

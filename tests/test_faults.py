@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.faults
+
 from commefficient_tpu.config import Config
 from commefficient_tpu.federated.api import FedModel, FedOptimizer
 from commefficient_tpu.federated.round import (

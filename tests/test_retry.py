@@ -1,0 +1,158 @@
+"""Bounded-retry policy (utils/retry) + the retry-guarded coordinator
+rendezvous (parallel/multihost.initialize) — ISSUE 2 satellite.
+
+The split under test: TRANSIENT failures (connection blips, gRPC
+DEADLINE_EXCEEDED/UNAVAILABLE from a neighbor host restarting) retry
+with exponential backoff up to a bound; FATAL failures (config
+mistakes, scripted InjectedFaults) re-raise immediately — a retry
+would silently defeat the fault-injection tests relying on them.
+"""
+import pytest
+
+from commefficient_tpu.utils.faults import InjectedFault
+from commefficient_tpu.utils.retry import is_transient_error, with_retries
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------- classification ------------------------------------------
+
+def test_classification_transient():
+    assert is_transient_error(ConnectionError("boom"))
+    assert is_transient_error(ConnectionResetError("reset"))
+    assert is_transient_error(TimeoutError("slow"))
+    # gRPC status strings surfaced as RuntimeError by the PJRT client
+    assert is_transient_error(RuntimeError(
+        "DEADLINE_EXCEEDED: Barrier timed out"))
+    assert is_transient_error(RuntimeError(
+        "UNAVAILABLE: failed to connect to all addresses"))
+    assert is_transient_error(OSError("Connection refused"))
+
+
+def test_classification_fatal():
+    assert not is_transient_error(ValueError("bad shape"))
+    assert not is_transient_error(KeyError("missing"))
+    # scripted faults must ALWAYS propagate (fault-injection tests)
+    assert not is_transient_error(InjectedFault(3))
+
+
+# ---------------- retry loop ----------------------------------------------
+
+def test_retries_transient_then_succeeds():
+    calls, sleeps = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("not yet")
+        return "ok"
+
+    assert with_retries(flaky, retries=3, base_delay=0.5,
+                        sleep=sleeps.append) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.5, 1.0]  # exponential backoff between attempts
+
+
+def test_backoff_caps_at_max_delay():
+    sleeps = []
+    n = [0]
+
+    def flaky():
+        n[0] += 1
+        if n[0] <= 5:
+            raise TimeoutError("still down")
+        return n[0]
+
+    with_retries(flaky, retries=5, base_delay=1.0, backoff=2.0,
+                 max_delay=3.0, sleep=sleeps.append)
+    assert sleeps == [1.0, 2.0, 3.0, 3.0, 3.0]
+
+
+def test_fatal_raises_immediately_no_sleep():
+    sleeps = []
+
+    def broken():
+        raise ValueError("config mistake")
+
+    with pytest.raises(ValueError):
+        with_retries(broken, retries=5, sleep=sleeps.append)
+    assert sleeps == []
+
+
+def test_injected_fault_never_retried():
+    calls = []
+
+    def scripted():
+        calls.append(1)
+        raise InjectedFault(7)
+
+    with pytest.raises(InjectedFault):
+        with_retries(scripted, retries=5, sleep=lambda _: None)
+    assert len(calls) == 1
+
+
+def test_exhausted_retries_reraise_last_error():
+    def always_down():
+        raise ConnectionError("dead for good")
+
+    with pytest.raises(ConnectionError, match="dead for good"):
+        with_retries(always_down, retries=2, sleep=lambda _: None)
+
+
+# ---------------- multihost.initialize retry -------------------------------
+
+def test_initialize_retries_transient_rendezvous(monkeypatch):
+    """The coordinator rendezvous retries transient connect failures
+    with backoff and passes the per-attempt timeout through to jax
+    when the installed version supports it."""
+    import jax
+
+    from commefficient_tpu.parallel import multihost as mh
+
+    attempts, sleeps, shutdowns = [], [], []
+
+    def fake_initialize(coordinator_address=None, num_processes=None,
+                        process_id=None, initialization_timeout=None,
+                        **kw):
+        attempts.append(initialization_timeout)
+        if len(attempts) < 3:
+            raise RuntimeError("UNAVAILABLE: coordinator not up yet")
+
+    monkeypatch.setattr(mh, "_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: shutdowns.append(1))
+    mh.initialize(coordinator_address="127.0.0.1:12345",
+                  num_processes=2, process_id=0,
+                  connect_timeout_s=60.0, connect_retries=3,
+                  retry_sleep=sleeps.append)
+    assert len(attempts) == 3
+    assert attempts[0] == 60  # timeout passed through per attempt
+    assert len(sleeps) == 2 and sleeps[1] > sleeps[0]
+    # each failed attempt tore the half-initialized global state down
+    # (jax sets its client before connect; without the shutdown the
+    # retry would hit 'initialize should only be called once')
+    assert len(shutdowns) == 2
+    assert mh._initialized
+    monkeypatch.setattr(mh, "_initialized", False)
+
+
+def test_initialize_fatal_error_not_retried(monkeypatch):
+    import jax
+
+    from commefficient_tpu.parallel import multihost as mh
+
+    calls = []
+
+    def fake_initialize(**kw):
+        calls.append(1)
+        raise ValueError("mismatched process grid")
+
+    monkeypatch.setattr(mh, "_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    with pytest.raises(ValueError):
+        mh.initialize(coordinator_address="127.0.0.1:12345",
+                      num_processes=2, process_id=0,
+                      retry_sleep=lambda _: None)
+    assert len(calls) == 1
+    assert not mh._initialized

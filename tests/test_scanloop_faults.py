@@ -1,0 +1,244 @@
+"""Fault injection through the scanned staging loop
+(training/scanloop.run_scanned_rounds) + mid-span preemption survival
+(ISSUE 2 tentpole + satellite).
+
+A SPAN is the atomic commit unit of scanned training: FedModel.
+run_rounds only assigns state from the scanned program's result, so a
+preemption while the span is in flight (FaultSchedule.crash_in_span)
+loses everything since the last span boundary. run_scanned_rounds
+therefore checkpoints at every boundary (its `checkpoint` hook), and
+these tests prove:
+
+  * FaultSchedule dropout through run_scanned_rounds lands on the same
+    bits as the per-round path, including the partial tail span;
+  * crash_after inside a span truncates it (rounds up to the crash
+    commit, then InjectedFault) — also in the tail span;
+  * emit returning False aborts the remaining rounds of the span,
+    matching the unscanned loop's stop-at-first-bad-round;
+  * crash_in_span commits NOTHING of the span, and resume from the
+    boundary checkpoint is bit-exact to the uninterrupted run.
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.training.scanloop import run_scanned_rounds
+from commefficient_tpu.utils.checkpoint import load_latest, save_rotating
+from commefficient_tpu.utils.faults import FaultSchedule, InjectedFault
+
+pytestmark = pytest.mark.faults
+
+D = 8
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+def _fed_model(**kw):
+    base = dict(mode="uncompressed", grad_size=D, weight_decay=0.0,
+                num_workers=8, local_momentum=0.0, virtual_momentum=0.9,
+                error_type="none", microbatch_size=-1, num_clients=8)
+    base.update(kw)
+    model = FedModel(None, loss_fn, Config(**base),
+                     params={"w": jnp.zeros(D)})
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+def _rounds(R, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(8, 4, D).astype(np.float32)
+    y = rng.randn(8, 4).astype(np.float32)
+    ids = np.arange(8, dtype=np.int32)
+    mask = np.ones((8, 4), np.float32)
+    return [(r, ids, (x, y), mask, 0.1) for r in range(R)]
+
+
+def _drive(model, stream, span_cap, checkpoint=None):
+    """run_scanned_rounds with a recording emit; returns (ok, emitted
+    tags)."""
+    emitted = []
+
+    def emit(tag, loss_w, aux_w):
+        emitted.append(tag)
+        return True
+
+    ok = run_scanned_rounds(model, iter(stream), span_cap, emit,
+                            checkpoint=checkpoint)
+    return ok, emitted
+
+
+# ---------------- dropout through the staging loop ------------------------
+
+def test_scanloop_dropout_matches_per_round_with_tail_span():
+    """5 rounds at span_cap=2 (spans 2+2+1, exercising the partial
+    tail) with scripted drops: identical bits to the per-round path."""
+    R = 5
+    stream = _rounds(R)
+    sched = FaultSchedule(drop_slots={1: [2, 5], 3: [0]})
+
+    model_a, opt_a = _fed_model()
+    model_a.set_fault_schedule(sched)
+    for _, ids, data, mask, _ in stream:
+        model_a((ids, data, mask))
+        opt_a.step()
+
+    model_b, _ = _fed_model()
+    model_b.set_fault_schedule(sched)
+    ok, emitted = _drive(model_b, stream, span_cap=2)
+    assert ok and emitted == [0, 1, 2, 3, 4]
+    np.testing.assert_array_equal(
+        np.asarray(model_b.server.ps_weights),
+        np.asarray(model_a.server.ps_weights))
+    assert int(np.asarray(model_b.server.round_idx)) == R
+
+
+def test_scanloop_crash_after_in_tail_span():
+    """crash_after landing in the PARTIAL TAIL span: completed rounds
+    commit, InjectedFault propagates out of the staging loop."""
+    stream = _rounds(5)
+    model, _ = _fed_model()
+    model.set_fault_schedule(FaultSchedule(crash_after=4))
+    with pytest.raises(InjectedFault) as exc:
+        _drive(model, stream, span_cap=2)
+    assert exc.value.round_idx == 4
+    assert int(np.asarray(model.server.round_idx)) == 5  # all committed
+
+
+def test_scanloop_emit_abort_stops_mid_span():
+    """emit returning False aborts immediately: the span's remaining
+    rounds are never emitted (matching the unscanned loop) and
+    run_scanned_rounds returns False — but the span's state had
+    already committed (the abort is a logging/NaN decision, not a
+    rollback)."""
+    stream = _rounds(6)
+    model, _ = _fed_model()
+    emitted = []
+
+    def emit(tag, loss_w, aux_w):
+        emitted.append(tag)
+        return tag != 2  # abort at the FIRST round of span 2
+
+    ok = run_scanned_rounds(model, iter(stream), 2, emit)
+    assert not ok
+    assert emitted == [0, 1, 2]  # round 3 of the same span never emits
+    # spans 0-1 and 2-3 both committed before the abort decision
+    assert int(np.asarray(model.server.round_idx)) == 4
+
+
+def test_scanloop_checkpoint_hook_called_per_span():
+    saves = []
+    model, _ = _fed_model()
+    ok, _ = _drive(model, _rounds(5), span_cap=2,
+                   checkpoint=lambda: saves.append(
+                       int(np.asarray(model.server.round_idx))))
+    assert ok
+    assert saves == [2, 4, 5]  # every boundary, tail included
+
+
+# ---------------- mid-span preemption -------------------------------------
+
+def test_crash_in_span_commits_nothing():
+    """A crash_in_span kill loses the WHOLE in-flight span: no state,
+    no accounting, no round-counter movement; the raised fault names
+    the last round that actually completed."""
+    R = 6
+    stream = _rounds(R)
+    model, _ = _fed_model()
+    model.set_fault_schedule(FaultSchedule(crash_in_span=3))
+    # first span (rounds 0-1) commits; second span (2-3) dies in flight
+    before_after = []
+
+    def checkpoint():
+        before_after.append(np.asarray(model.server.ps_weights).copy())
+
+    with pytest.raises(InjectedFault) as exc:
+        _drive(model, stream, span_cap=2, checkpoint=checkpoint)
+    assert exc.value.round_idx == 1  # last span boundary
+    assert int(np.asarray(model.server.round_idx)) == 2
+    assert len(before_after) == 1  # only span 0's boundary checkpoint
+    np.testing.assert_array_equal(
+        np.asarray(model.server.ps_weights), before_after[0])
+    # accounting saw only the committed span
+    assert model.accountant.stale.max() == 1
+
+
+def test_crash_in_span_per_round_path_commits_nothing():
+    """On the per-round path each round is its own span of one: the
+    kill lands before ANYTHING of that round commits."""
+    stream = _rounds(3)
+    model, opt = _fed_model()
+    model.set_fault_schedule(FaultSchedule(crash_in_span=2))
+    _, ids, data, mask, _ = stream[0]
+    model((ids, data, mask))
+    model((ids, data, mask))
+    before = np.asarray(model.server.ps_weights).copy()
+    with pytest.raises(InjectedFault) as exc:
+        model((ids, data, mask))
+    assert exc.value.round_idx == 1
+    assert int(np.asarray(model.server.round_idx)) == 2
+    np.testing.assert_array_equal(
+        np.asarray(model.server.ps_weights), before)
+
+
+def test_midspan_crash_resume_bit_exact(ckpt_dir):
+    """The acceptance case: scripted mid-span kill, resume from the
+    span-boundary checkpoint written by run_scanned_rounds'
+    `checkpoint` hook, finish the remaining rounds scanned — final
+    state bit-exact to the uninterrupted run. Random dropout AND
+    random stragglers ride across the boundary, so the resumed spans
+    must replay identical fault draws."""
+    R, SPAN = 6, 2
+    common = dict(client_dropout=0.2, straggler_rate=0.4,
+                  straggler_min_work=0.3)
+    stream = _rounds(R, seed=9)
+
+    # uninterrupted reference (same span structure, no faults script)
+    model_a, _ = _fed_model(**common)
+    ok, _ = _drive(model_a, stream, SPAN)
+    assert ok
+    want = np.asarray(model_a.server.ps_weights)
+
+    # crashing run: checkpoint at every span boundary, preemption
+    # mid-span-2 (crash_in_span=3 lands in rounds [2, 4))
+    prefix = os.path.join(ckpt_dir, "midspan")
+    model_b, _ = _fed_model(**common)
+    model_b.set_fault_schedule(FaultSchedule(crash_in_span=3))
+
+    def save_b():
+        save_rotating(prefix, model_b.server, model_b.clients,
+                      keep_last=2,
+                      accountant=model_b.accountant,
+                      prev_change_words=np.asarray(
+                          model_b._prev_change_words),
+                      fingerprint=model_b.checkpoint_fingerprint)
+
+    with pytest.raises(InjectedFault):
+        _drive(model_b, stream, SPAN, checkpoint=save_b)
+
+    # fresh process: resume from the last flushed span's checkpoint
+    # and drive the REMAINING stream through the same staging loop
+    model_c, _ = _fed_model(**common)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None
+    model_c.load_state(ckpt)
+    done = int(np.asarray(ckpt.server.round_idx))
+    assert done == 2  # the last span boundary before the kill
+    ok, _ = _drive(model_c, stream[done:], SPAN)
+    assert ok
+    np.testing.assert_array_equal(
+        np.asarray(model_c.server.ps_weights), want,
+        err_msg="mid-span crash -> resume diverged from uninterrupted")
+    assert int(np.asarray(model_c.server.round_idx)) == R

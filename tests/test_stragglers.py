@@ -1,0 +1,418 @@
+"""Straggler (partial-work client) semantics: deterministic work-
+fraction draws, FedNova-style processed-example reweighting, the
+below-cutoff degradation to dropout, and crash->resume replay with
+stragglers active (ISSUE 2 tentpole).
+
+Contract under test (round.RoundBatch.work / Config.straggler_*):
+  * work fractions are a pure function of (seed, round) on a PRNG
+    stream distinct from the dropout draw — resume replays them;
+  * a client with fraction f processes only its first ceil(f * valid)
+    examples (single-step modes) / ceil(f * steps) local SGD steps
+    (fedavg), and aggregation weights by examples ACTUALLY processed;
+  * work_fraction < straggler_cutoff degrades to the dropout path
+    BIT-identically (the work operand collapses to None, so the exact
+    dropout program runs);
+  * straggler_rate=0.0 keeps the work operand out of the round
+    entirely (the machinery is free when disabled).
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated import client as fc
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.federated.round import (
+    RoundBatch, init_client_state, init_server_state, make_round_fns,
+)
+from commefficient_tpu.ops.flat import flatten_params
+from commefficient_tpu.parallel.mesh import make_client_mesh
+from commefficient_tpu.utils.checkpoint import load_latest, save_rotating
+from commefficient_tpu.utils.faults import (
+    FaultSchedule, InjectedFault, bernoulli_survivors,
+    straggler_work_fractions,
+)
+
+pytestmark = pytest.mark.faults
+
+D = 8
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+def _problem(seed=0, W=8, B=4):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(W, B, D).astype(np.float32)
+    y = rng.randn(W, B).astype(np.float32)
+    return x, y
+
+
+def _engine(mesh, mode="uncompressed", num_workers=8, **kw):
+    params = {"w": jnp.zeros(D)}
+    vec, unravel = flatten_params(params)
+    base = dict(mode=mode, grad_size=D, weight_decay=0.0,
+                num_workers=num_workers, local_momentum=0.0,
+                virtual_momentum=0.0, error_type="none",
+                microbatch_size=-1, num_clients=num_workers)
+    base.update(kw)
+    cfg = Config(**base)
+    train_round, _ = make_round_fns(loss_fn, unravel, cfg, mesh)
+    server = init_server_state(cfg, vec)
+    clients = init_client_state(cfg, base["num_clients"], vec)
+    return cfg, train_round, server, clients
+
+
+def _fed_model(mode, **kw):
+    base = dict(mode=mode, grad_size=D, weight_decay=0.0, num_workers=8,
+                local_momentum=0.0, virtual_momentum=0.0,
+                error_type="none", microbatch_size=-1, num_clients=8)
+    base.update(kw)
+    model = FedModel(None, loss_fn, Config(**base),
+                     params={"w": jnp.zeros(D)})
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+def _state_arrays(model):
+    return {
+        "ps_weights": np.asarray(model.server.ps_weights),
+        "Vvelocity": np.asarray(model.server.Vvelocity),
+        "Verror": np.asarray(model.server.Verror),
+        "round_idx": np.asarray(model.server.round_idx),
+        "errors": np.asarray(model.clients.errors),
+        "velocities": np.asarray(model.clients.velocities),
+    }
+
+
+# ---------------- the production draw ------------------------------------
+
+def test_work_fractions_deterministic_and_bounded():
+    a = straggler_work_fractions(21, 7, 64, rate=0.5, min_work=0.2)
+    b = straggler_work_fractions(21, 7, 64, rate=0.5, min_work=0.2)
+    np.testing.assert_array_equal(a, b)  # replay contract
+    assert not np.array_equal(
+        a, straggler_work_fractions(21, 8, 64, rate=0.5, min_work=0.2))
+    stragglers = a < 1.0
+    assert 0 < stragglers.sum() < 64  # some slow, some full, at this W
+    assert np.all(a[stragglers] >= 0.2) and np.all(a <= 1.0)
+    np.testing.assert_array_equal(
+        straggler_work_fractions(21, 7, 64, rate=0.0),
+        np.ones(64, np.float32))
+
+
+def test_work_stream_does_not_alias_dropout_stream():
+    """The straggler draw and the dropout draw at the same (seed,
+    round) must come from distinct PRNG domains: a client's being slow
+    must not be correlated with its being dropped."""
+    surv = bernoulli_survivors(21, 7, 256, 0.5)
+    work = straggler_work_fractions(21, 7, 256, rate=0.5)
+    assert not np.array_equal(surv == 0.0, work < 1.0)
+
+
+def test_schedule_slow_fractions_and_composition():
+    sched = FaultSchedule(slow={2: {1: 0.25, 3: 0.5}})
+    assert sched.work_fractions(0, 4) is None
+    np.testing.assert_array_equal(sched.work_fractions(2, 4),
+                                  [1.0, 0.25, 1.0, 0.5])
+
+
+def test_schedule_rejects_zero_work_fraction():
+    """Work fractions live in (0, 1]: zero work is a DROPPED client
+    (drop/drop_slots), not a straggler — ceil(0 * valid) would process
+    nothing yet still scatter fresh error rows back. The scripted path
+    enforces the same domain the random draw's min_work validation
+    does."""
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(ValueError, match="work-fraction domain"):
+            FaultSchedule(slow={2: {0: bad}}).work_fractions(2, 4)
+
+
+# ---------------- disabled == free ---------------------------------------
+
+def test_straggler_zero_keeps_workfree_program():
+    """straggler_rate=0.0 (default) must keep the work operand out of
+    the round entirely (None -> the pre-straggler treedef), and an
+    all-ones scripted work vector must collapse back to None too."""
+    model, _ = _fed_model("uncompressed")
+    surv, work = model._faults_for_round(0, np.arange(8))
+    assert surv is None and work is None
+
+    model.set_fault_schedule(FaultSchedule(slow={0: {1: 1.0}}))
+    surv, work = model._faults_for_round(0, np.arange(8))
+    assert surv is None and work is None  # ones collapse
+
+    slow, _ = _fed_model("uncompressed", straggler_rate=0.9)
+    _, work = slow._faults_for_round(0, np.arange(8))
+    assert work is not None and work.min() < 1.0
+
+
+def test_work_ones_matches_workfree_program(mesh):
+    """An all-ones work vector is numerically identical to the
+    work-free program (fused and per-client paths)."""
+    x, y = _problem(seed=2)
+    key = jax.random.PRNGKey(0)
+    for mode, extra in (("uncompressed", {}),        # fused backward
+                        ("local_topk", dict(k=2, error_type="local"))):
+        _, tr, server, clients = _engine(mesh, mode, **extra)
+        ids = jnp.arange(8, dtype=jnp.int32)
+        plain = RoundBatch(ids, (x, y), jnp.ones((8, 4)))
+        worked = plain._replace(survivors=jnp.ones(8), work=jnp.ones(8))
+        s_a, c_a, m_a = tr(server, clients, plain, 0.1, key)
+        s_b, c_b, m_b = tr(server, clients, worked, 0.1, key)
+        np.testing.assert_allclose(np.asarray(s_a.ps_weights),
+                                   np.asarray(s_b.ps_weights),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(np.asarray(m_a.num_examples),
+                                      np.asarray(m_b.num_examples))
+
+
+# ---------------- partial-work reweighting -------------------------------
+
+def test_partial_work_reweighting_two_client_hand_case():
+    """2 clients, client 1 at half work (keeps 2 of its 4 examples):
+    update = lr * (sum-grad(c0, all 4) + sum-grad(c1, first 2)) / 6 —
+    each client weighted by examples ACTUALLY processed (FedNova), not
+    by its nominal batch size."""
+    mesh2 = make_client_mesh(2)
+    _, tr, server, clients = _engine(mesh2, "uncompressed",
+                                     num_workers=2)
+    x, y = _problem(seed=1, W=2)
+    key = jax.random.PRNGKey(0)
+    batch = RoundBatch(jnp.arange(2, dtype=jnp.int32), (x, y),
+                       jnp.ones((2, 4)),
+                       survivors=jnp.ones(2),
+                       work=jnp.asarray([1.0, 0.5]))
+    s1, _, metrics = tr(server, clients, batch, 0.1, key)
+
+    # per-example grad at w=0: x_b * (x_b @ 0 - y_b)
+    g0 = (x[0] * (x[0] @ np.zeros(D) - y[0])[:, None]).sum(0)
+    g1 = (x[1, :2] * (x[1, :2] @ np.zeros(D) - y[1, :2])[:, None]).sum(0)
+    np.testing.assert_allclose(np.asarray(s1.ps_weights),
+                               -0.1 * (g0 + g1) / 6.0,
+                               rtol=1e-5, atol=1e-6)
+    # example counts reflect processed work, not nominal batch
+    np.testing.assert_array_equal(np.asarray(metrics.num_examples),
+                                  [4.0, 2.0])
+
+
+def test_partial_work_truncates_prefix_not_padding():
+    """The completed-examples budget must walk VALID examples in
+    order: with padding already masked out, a straggler keeps a prefix
+    of its real examples, never resurrecting padding rows."""
+    mesh2 = make_client_mesh(2)
+    _, tr, server, clients = _engine(mesh2, "uncompressed",
+                                     num_workers=2)
+    x, y = _problem(seed=3, W=2)
+    key = jax.random.PRNGKey(0)
+    # client 1: only 3 valid examples (last row is padding), half work
+    # -> ceil(0.5 * 3) = 2 examples processed
+    mask = np.ones((2, 4), np.float32)
+    mask[1, 3] = 0.0
+    batch = RoundBatch(jnp.arange(2, dtype=jnp.int32), (x, y),
+                       jnp.asarray(mask),
+                       survivors=jnp.ones(2),
+                       work=jnp.asarray([1.0, 0.5]))
+    _, _, metrics = tr(server, clients, batch, 0.1, key)
+    np.testing.assert_array_equal(np.asarray(metrics.num_examples),
+                                  [4.0, 2.0])
+
+
+def test_fedavg_work_budget_completed_steps():
+    """fedavg: work is a completed-STEPS budget. Half work over
+    2 epochs x 2 batches (4 steps) runs exactly the first 2 steps —
+    the same weights a 1-epoch run reaches — and the transmitted
+    delta is weighted by examples processed (half the dataset-size
+    weighting)."""
+    params = {"w": jnp.array([2.0])}
+    vec, unravel = flatten_params(params)
+    fg = fc.make_flat_grad_fn(loss_fn_scalar, unravel)
+    batch = (jnp.asarray([1.0, 2.0], jnp.float32),
+             jnp.asarray([0.5, -0.5], jnp.float32))
+    mask = jnp.ones(2)
+
+    def cfg_of(epochs):
+        return Config(mode="fedavg", grad_size=1, weight_decay=0.0,
+                      num_workers=1, local_momentum=0.0,
+                      error_type="none", microbatch_size=-1,
+                      fedavg_batch_size=1, num_fedavg_epochs=epochs)
+
+    full = fc.fedavg_step(fg, vec, batch, mask, cfg_of(1), lr=0.1)
+    half = fc.fedavg_step(fg, vec, batch, mask, cfg_of(2), lr=0.1,
+                          work=jnp.asarray(0.5))
+    # same 2 completed steps -> same weight trajectory, half count
+    np.testing.assert_allclose(np.asarray(half.num_examples), 1.0)
+    np.testing.assert_allclose(np.asarray(full.num_examples), 2.0)
+    np.testing.assert_allclose(2.0 * np.asarray(half.transmit),
+                               np.asarray(full.transmit),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_fedavg_work_one_matches_workfree():
+    """work=1.0 applies every step (the gate multiplies by exactly
+    1.0), matching the work-free program bit-for-bit."""
+    params = {"w": jnp.array([2.0])}
+    vec, unravel = flatten_params(params)
+    fg = fc.make_flat_grad_fn(loss_fn_scalar, unravel)
+    batch = (jnp.asarray([1.0, 2.0], jnp.float32),
+             jnp.asarray([0.5, -0.5], jnp.float32))
+    mask = jnp.ones(2)
+    cfg = Config(mode="fedavg", grad_size=1, weight_decay=0.0,
+                 num_workers=1, local_momentum=0.0, error_type="none",
+                 microbatch_size=-1, fedavg_batch_size=1,
+                 num_fedavg_epochs=2)
+    a = fc.fedavg_step(fg, vec, batch, mask, cfg, lr=0.1)
+    b = fc.fedavg_step(fg, vec, batch, mask, cfg, lr=0.1,
+                       work=jnp.asarray(1.0))
+    np.testing.assert_array_equal(np.asarray(a.transmit),
+                                  np.asarray(b.transmit))
+    np.testing.assert_array_equal(np.asarray(a.num_examples),
+                                  np.asarray(b.num_examples))
+
+
+def loss_fn_scalar(params, batch, mask):
+    x, y = batch
+    pred = params["w"] * x
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+# ---------------- cutoff degradation to dropout --------------------------
+
+def test_below_cutoff_bit_identical_to_dropped_client():
+    """A work fraction under straggler_cutoff must run the EXACT
+    dropout program an explicitly-dropped client runs: every state
+    array bit-identical across 3 rounds."""
+    data = _problem(seed=4)
+    x, y = data
+    ids = np.arange(8, dtype=np.int32)
+    mask = np.ones((8, 4), np.float32)
+    extra = dict(k=2, error_type="local", local_momentum=0.5)
+
+    slow, opt_a = _fed_model("local_topk", straggler_cutoff=0.2, **extra)
+    slow.set_fault_schedule(FaultSchedule(slow={1: {3: 0.05}}))
+    dropped, opt_b = _fed_model("local_topk", **extra)
+    dropped.set_fault_schedule(FaultSchedule(drop_slots={1: [3]}))
+
+    for model, opt in ((slow, opt_a), (dropped, opt_b)):
+        for _ in range(3):
+            model((ids, (x, y), mask))
+            opt.step()
+
+    want, got = _state_arrays(dropped), _state_arrays(slow)
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"below-cutoff straggler != dropped client: {name}")
+    # and the degraded round really did collapse work to None (the
+    # dropout program, not the work program with a spectator operand)
+    surv, work = slow._faults_for_round(1, ids)
+    assert work is None and surv is not None and surv[3] == 0.0
+
+
+def test_cutoff_degradation_charges_nothing():
+    """Accounting for a below-cutoff straggler matches a dropped
+    client: zero upload, zero download, staleness keeps growing."""
+    model, opt = _fed_model("uncompressed", straggler_cutoff=0.3)
+    model.set_fault_schedule(FaultSchedule(slow={1: {3: 0.1}}))
+    x, y = _problem()
+    ids = np.arange(8, dtype=np.int32)
+    mask = np.ones((8, 4), np.float32)
+    model((ids, (x, y), mask))                      # round 0: all live
+    _, _, down1, up1 = model((ids, (x, y), mask))   # round 1: 3 degrades
+    assert up1[3] == 0.0 and down1[3] == 0.0
+    live = [c for c in range(8) if c != 3]
+    assert np.all(up1[live] > 0)
+    assert model.accountant.stale[3] == 2
+
+
+# ---------------- scanned parity + crash -> resume -----------------------
+
+def test_scanned_stragglers_match_unscanned():
+    """run_rounds with random stragglers + dropout must land on the
+    same bits as the per-round path (the [N, W] work stacking replays
+    the identical per-round draws)."""
+    R = 4
+    x, y = _problem(seed=6)
+    ids = np.arange(8, dtype=np.int32)
+    mask = np.ones((8, 4), np.float32)
+    common = dict(straggler_rate=0.5, straggler_min_work=0.3,
+                  client_dropout=0.2, virtual_momentum=0.9)
+
+    model_a, opt_a = _fed_model("uncompressed", **common)
+    for _ in range(R):
+        model_a((ids, (x, y), mask))
+        opt_a.step()
+
+    model_b, _ = _fed_model("uncompressed", **common)
+    N_ids = np.broadcast_to(ids, (R, 8)).copy()
+    N_x = np.broadcast_to(x, (R,) + x.shape).copy()
+    N_y = np.broadcast_to(y, (R,) + y.shape).copy()
+    N_mask = np.ones((R, 8, 4), np.float32)
+    model_b.run_rounds(N_ids, (N_x, N_y), N_mask,
+                       np.full(R, 0.1, np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(model_b.server.ps_weights),
+        np.asarray(model_a.server.ps_weights))
+
+
+def test_straggler_crash_resume_bit_identical(ckpt_dir):
+    """Crash-after-round-k + resume with BOTH random stragglers and
+    random dropout active across the boundary: the resumed run must
+    replay the identical work fractions (pure function of seed+round),
+    landing bit-identically on every state array."""
+    R, K = 6, 3
+    data = _problem(seed=5)
+    common = dict(client_dropout=0.2, straggler_rate=0.5,
+                  straggler_min_work=0.3, k=D, num_rows=2, num_cols=64,
+                  num_blocks=1, error_type="virtual",
+                  virtual_momentum=0.9)
+    x, y = data
+    ids = np.arange(8, dtype=np.int32)
+    mask = np.ones((8, 4), np.float32)
+
+    model_a, opt_a = _fed_model("sketch", **common)
+    for _ in range(R):
+        model_a((ids, (x, y), mask))
+        opt_a.step()
+    want = _state_arrays(model_a)
+
+    prefix = os.path.join(ckpt_dir, "straggler")
+    model_b, opt_b = _fed_model("sketch", **common)
+    model_b.set_fault_schedule(FaultSchedule(crash_after=K))
+    with pytest.raises(InjectedFault):
+        for _ in range(R):
+            model_b((ids, (x, y), mask))
+            opt_b.step()
+            save_rotating(prefix, model_b.server, model_b.clients,
+                          keep_last=2,
+                          accountant=model_b.accountant,
+                          prev_change_words=np.asarray(
+                              model_b._prev_change_words),
+                          fingerprint=model_b.checkpoint_fingerprint)
+
+    model_c, opt_c = _fed_model("sketch", **common)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    model_c.load_state(ckpt)
+    for _ in range(int(np.asarray(ckpt.server.round_idx)), R):
+        model_c((ids, (x, y), mask))
+        opt_c.step()
+
+    got = _state_arrays(model_c)
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"straggler crash->resume diverged: {name}")
