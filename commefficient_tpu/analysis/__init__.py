@@ -1,0 +1,28 @@
+"""graftlint: trace-safety static analysis + runtime sanitizers.
+
+PRs 1-2 bought the round engine hard guarantees — exactly three traced
+round programs (mask-free, dropout, dropout+stragglers), bit-exact
+crash->resume, PRNG domain separation between the dropout and straggler
+draws — but nothing enforced them except hand-written tests a future
+refactor could silently rot. This package makes the invariants
+mechanical:
+
+  * `engine` + `rules` — an AST lint pass (``python -m
+    commefficient_tpu.analysis <paths>``) with JAX-specific rules
+    GL001-GL006: host nondeterminism reachable from traced code, hidden
+    host syncs / trace breaks, PRNG key reuse, Python control flow over
+    traced values, fault-swallowing broad ``except`` handlers, and
+    non-atomic file writes. Per-line ``# graftlint: disable=GLxxx``
+    suppressions and a baseline file grandfather justified hits.
+  * `runtime` — sanitizers armed by tests: ``assert_program_count(n)``
+    (a compilation counter enforcing the three-programs contract) and
+    ``forbid_transfers()`` (``jax.transfer_guard`` proving the jitted
+    round performs zero implicit host transfers).
+
+The static pass is deliberately jax-free (pure ``ast``) so it runs in
+any environment — only `runtime` imports jax.
+"""
+from commefficient_tpu.analysis.engine import (  # noqa: F401
+    Baseline, LintError, Violation, lint_paths, lint_source,
+)
+from commefficient_tpu.analysis.rules import ALL_RULES, RULE_DOCS  # noqa: F401

@@ -1,0 +1,119 @@
+"""CLI: ``python -m commefficient_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (after baseline), 1 violations or stale baseline
+or lint errors, 2 usage errors. Configuration lives in pyproject.toml
+under ``[tool.graftlint]`` (paths, baseline, exclude) — flags override.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Optional
+
+from commefficient_tpu.analysis.engine import (
+    Baseline, LintError, lint_paths,
+)
+from commefficient_tpu.analysis.rules import RULE_DOCS
+
+
+def _load_pyproject_config(start: str = ".") -> dict:
+    """[tool.graftlint] from the nearest pyproject.toml, via tomllib/
+    tomli when available, else a minimal line parser good enough for
+    the flat strings-and-string-lists section this tool defines."""
+    path = os.path.join(start, "pyproject.toml")
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        try:
+            import tomllib  # py311+
+        except ImportError:
+            import tomli as tomllib
+        return tomllib.loads(text).get("tool", {}).get("graftlint", {})
+    except ImportError:
+        pass
+    m = re.search(r"^\[tool\.graftlint\]\s*$(.*?)(?=^\[|\Z)", text,
+                  re.M | re.S)
+    if not m:
+        return {}
+    out: dict = {}
+    for line in m.group(1).splitlines():
+        kv = re.match(r"\s*(\w+)\s*=\s*(.+?)\s*$", line)
+        if not kv:
+            continue
+        key, val = kv.group(1), kv.group(2)
+        if val.startswith("["):
+            out[key] = re.findall(r'"([^"]*)"', val)
+        elif val.startswith('"'):
+            out[key] = val.strip('"')
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    conf = _load_pyproject_config()
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="trace-safety static analysis for the round engine "
+                    "(rules GL001-GL006; see --list-rules)")
+    ap.add_argument("paths", nargs="*",
+                    default=conf.get("paths", ["commefficient_tpu"]),
+                    help="files/directories to lint")
+    ap.add_argument("--baseline", default=conf.get(
+        "baseline", "graftlint.baseline.json"),
+        help="baseline file of grandfathered hits")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every hit, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from the current tree")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, doc in sorted(RULE_DOCS.items()):
+            print(f"{code}  {doc}")
+        return 0
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    try:
+        violations = lint_paths(args.paths,
+                                exclude=conf.get("exclude", ()))
+    except LintError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 1
+
+    if args.write_baseline:
+        Baseline.from_violations(violations).dump(args.baseline)
+        print(f"graftlint: wrote {len(violations)} grandfathered hit(s) "
+              f"to {args.baseline}")
+        return 0
+
+    baseline = Baseline()
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = Baseline.load(args.baseline)
+    new, stale = baseline.apply(violations)
+
+    for v in new:
+        print(v.render())
+    for msg in stale:
+        print(f"graftlint: {msg}")
+    n_files = len(set(v.path for v in violations))
+    if new or stale:
+        print(f"graftlint: {len(new)} violation(s)"
+              + (f", {len(stale)} baseline problem(s)" if stale else ""))
+        return 1
+    grandfathered = len(violations)
+    print("graftlint: clean"
+          + (f" ({grandfathered} grandfathered hit(s) in {n_files} "
+             f"file(s) — see {args.baseline})" if grandfathered else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

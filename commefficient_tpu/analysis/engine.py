@@ -1,0 +1,179 @@
+"""graftlint engine: parse, run rules, apply suppressions + baseline.
+
+Deliberately jax-free (pure ``ast`` + stdlib) so the pass runs in any
+environment — CI boxes without accelerators, pre-commit hooks, the
+tier-1 recipe. Rule logic lives in `rules`; this module owns the
+mechanics every rule shares:
+
+  * per-line suppressions — ``# graftlint: disable=GL001[,GL002]`` on
+    the reported line silences those rules there (a justification after
+    ``--`` is conventional and encouraged);
+  * the BASELINE file — JSON grandfathering existing hits per
+    (path, rule) with a justification, so new violations fail CI while
+    documented legacy ones don't. The baseline must match the tree
+    EXACTLY: a fixed violation leaves a stale entry behind, and the
+    engine reports staleness as an error too, so the baseline can only
+    shrink deliberately (regenerate with ``--write-baseline``).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: " \
+               f"{self.rule} {self.message}"
+
+
+class LintError(RuntimeError):
+    """A file could not be linted (unreadable / syntax error)."""
+
+
+def _suppressions(source: str) -> Dict[int, set]:
+    out: Dict[int, set] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip().upper() for r in m.group(1).split(",")
+                      if r.strip()}
+    return out
+
+
+def lint_source(path: str, source: str,
+                rules: Optional[Dict] = None) -> List[Violation]:
+    """Lint one file's source. `path` is used for reporting only."""
+    from commefficient_tpu.analysis.rules import ALL_RULES, ModuleInfo
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        raise LintError(f"{path}: syntax error: {e}") from e
+    module = ModuleInfo(path, source, tree)
+    suppressed = _suppressions(source)
+    out: List[Violation] = []
+    for code, check in (rules or ALL_RULES).items():
+        for v in check(module):
+            if v.rule in suppressed.get(v.line, ()):
+                continue
+            out.append(v)
+    return sorted(set(out))
+
+
+def iter_python_files(paths: Sequence[str],
+                      exclude: Sequence[str] = ()) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for f in sorted(files):
+                if not f.endswith(".py"):
+                    continue
+                full = os.path.join(root, f)
+                rel = full.replace(os.sep, "/")
+                if any(pat in rel for pat in exclude):
+                    continue
+                yield full
+
+
+def lint_paths(paths: Sequence[str],
+               exclude: Sequence[str] = ()) -> List[Violation]:
+    out: List[Violation] = []
+    for path in iter_python_files(paths, exclude):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(path).replace(os.sep, "/")
+        out.extend(lint_source(rel, source))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+class Baseline:
+    """Grandfathered hits: {(path, rule): (count, justification)}."""
+
+    def __init__(self, entries: Optional[Dict[Tuple[str, str],
+                                              Tuple[int, str]]] = None):
+        self.entries = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        entries = {}
+        for e in raw.get("entries", ()):
+            entries[(e["path"], e["rule"])] = (
+                int(e["count"]), e.get("justification", ""))
+        return cls(entries)
+
+    @classmethod
+    def from_violations(cls, violations: Sequence[Violation]) -> "Baseline":
+        counts: Dict[Tuple[str, str], int] = {}
+        for v in violations:
+            counts[(v.path, v.rule)] = counts.get((v.path, v.rule), 0) + 1
+        return cls({k: (n, "TODO: justify or fix")
+                    for k, n in counts.items()})
+
+    def dump(self, path: str) -> None:
+        entries = [
+            {"path": p, "rule": r, "count": n, "justification": j}
+            for (p, r), (n, j) in sorted(self.entries.items())
+        ]
+        text = json.dumps({"version": 1, "entries": entries}, indent=2)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        os.replace(tmp, path)
+
+    def apply(self, violations: Sequence[Violation]
+              ) -> Tuple[List[Violation], List[str]]:
+        """Split a scan against the baseline. Returns (new_violations,
+        stale_messages): a (path, rule) group with MORE hits than its
+        entry surfaces the overflow as new violations (most-recent
+        lines first would be arbitrary — all are reported); a group
+        with FEWER hits than its entry is stale (the tree improved:
+        shrink the baseline so the win is locked in)."""
+        by_key: Dict[Tuple[str, str], List[Violation]] = {}
+        for v in violations:
+            by_key.setdefault((v.path, v.rule), []).append(v)
+        new: List[Violation] = []
+        stale: List[str] = []
+        for key, vs in sorted(by_key.items()):
+            allowed = self.entries.get(key, (0, ""))[0]
+            if len(vs) > allowed:
+                # overflow: the whole group is re-reported (line
+                # numbers churn, so WHICH hits are new is unknowable)
+                new.extend(vs)
+        for key, (count, _) in sorted(self.entries.items()):
+            have = len(by_key.get(key, ()))
+            if have < count:
+                stale.append(
+                    f"stale baseline entry {key[0]} {key[1]}: baseline "
+                    f"grandfathers {count}, tree has {have} — "
+                    "regenerate with --write-baseline to lock in the fix")
+            elif have > count and count > 0:
+                # overflow groups were fully re-reported above; note why
+                stale.append(
+                    f"baseline entry {key[0]} {key[1]} exceeded: "
+                    f"grandfathers {count}, tree has {have}")
+        return new, stale

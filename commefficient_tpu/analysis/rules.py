@@ -1,0 +1,534 @@
+"""graftlint rules GL001-GL006.
+
+Each rule is a function ``check(module: ModuleInfo) -> Iterator[
+Violation]`` over one parsed file. The rules are deliberately
+mechanical: they encode the round engine's invariants (see
+analysis/__init__ and README "Invariants & graftlint") as syntactic
+patterns, erring toward precision over recall — a lint that cries wolf
+gets disabled, while a narrow one that holds the line on the contracts
+it CAN see stays armed in CI forever.
+
+Traced-code scoping (GL001/GL002/GL004): a function is considered
+TRACED when it is (a) decorated with ``jax.jit`` / ``vmap`` / ``pmap``
+/ ``shard_map`` / ``checkpoint`` (bare or under ``partial(...)``),
+(b) passed by name to ``jax.jit(f)`` / ``jax.vmap(f)`` /
+``jax.lax.scan(f, ...)`` / ``jax.lax.cond(p, f, g)`` /
+``shard_map(f, ...)`` / ``jax.grad(f)`` and friends anywhere in the
+same file, or (c) lexically nested inside a traced function (the round
+engine's ``shard_train`` -> ``one_client`` -> closure tower). This is
+lexical reachability, not a call graph: a helper called from traced
+code but defined at module scope and never registered with a transform
+is NOT scanned — the factory idiom this codebase uses everywhere
+(make_train_fn closures) keeps traced code lexically nested, which is
+exactly what makes the lexical rule strong here.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from commefficient_tpu.analysis.engine import Violation
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Dotted source name of a Name/Attribute chain ('jax.random.split'),
+    or None when the expression is not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+class ModuleInfo:
+    """One parsed file plus the derived facts every rule shares: parent
+    links, the set of traced function/lambda nodes, and source text."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.traced_roots = _find_traced_roots(tree)
+
+    def enclosing_functions(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                yield cur
+            cur = self.parents.get(cur)
+
+    def in_traced(self, node: ast.AST) -> bool:
+        """True when `node` sits lexically inside a traced function."""
+        if node in self.traced_roots:
+            return True
+        return any(f in self.traced_roots
+                   for f in self.enclosing_functions(node))
+
+    def segment(self, node: ast.AST) -> str:
+        try:
+            return ast.get_source_segment(self.source, node) or ""
+        except Exception:  # graftlint: disable=GL005 -- best-effort source echo
+            return ""
+
+
+# transform entry points whose function-valued arguments become traced
+_TRACE_ENTRY_CALLS = frozenset({
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "scan", "cond",
+    "while_loop", "fori_loop", "switch", "shard_map", "checkpoint",
+    "remat", "associative_scan", "custom_vjp", "custom_jvp",
+})
+_TRACE_DECORATORS = frozenset({
+    "jit", "pmap", "vmap", "shard_map", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp",
+})
+
+
+def _decorator_marks_traced(dec: ast.expr) -> bool:
+    name = _terminal(_dotted(dec))
+    if name in _TRACE_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        if _terminal(_dotted(dec.func)) in _TRACE_DECORATORS:
+            return True
+        # @partial(jax.jit, static_argnums=...) and friends
+        if _terminal(_dotted(dec.func)) == "partial":
+            return any(_terminal(_dotted(a)) in _TRACE_DECORATORS
+                       for a in dec.args)
+    return False
+
+
+def _find_traced_roots(tree: ast.Module) -> Set[ast.AST]:
+    by_name: Dict[str, List[ast.AST]] = {}
+    roots: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+            if any(_decorator_marks_traced(d) for d in node.decorator_list):
+                roots.add(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal(_dotted(node.func)) not in _TRACE_ENTRY_CALLS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                roots.add(arg)
+            name = _dotted(arg)
+            if name and "." not in name:
+                roots.update(by_name.get(name, ()))
+    return roots
+
+
+def _walk_traced(module: ModuleInfo) -> Iterator[ast.AST]:
+    """Every node lexically inside a traced root, visited once."""
+    seen: Set[ast.AST] = set()
+    for root in module.traced_roots:
+        body = root.body if isinstance(root.body, list) else [root.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if node not in seen:
+                    seen.add(node)
+                    yield node
+
+
+# ---------------------------------------------------------------------------
+# GL001 — host nondeterminism reachable from traced code
+
+_GL001_CLOCKS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+})
+_NP_GLOBAL_DRAWS = frozenset({
+    "rand", "randn", "random", "random_sample", "randint", "choice",
+    "permutation", "shuffle", "uniform", "normal", "standard_normal",
+    "beta", "binomial", "poisson", "exponential", "bytes",
+})
+_PY_RANDOM_DRAWS = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "uniform", "gauss", "sample", "betavariate", "getrandbits",
+})
+
+
+def check_gl001(module: ModuleInfo) -> Iterator[Violation]:
+    for node in _walk_traced(module):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if not name:
+            continue
+        what = None
+        if name in _GL001_CLOCKS or name.endswith(".datetime.now"):
+            what = f"host clock `{name}()`"
+        elif (name.startswith(("np.random.", "numpy.random."))
+              and _terminal(name) in _NP_GLOBAL_DRAWS):
+            what = f"unseeded global-state draw `{name}()`"
+        elif (name.startswith("random.")
+              and _terminal(name) in _PY_RANDOM_DRAWS):
+            what = f"unseeded `{name}()`"
+        if what:
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL001",
+                f"{what} inside traced code: the value freezes at trace "
+                "time (or retraces nondeterministically), breaking the "
+                "pure-(state, seed, round) round contract; thread a "
+                "seeded generator / jax.random key in as data")
+
+
+# ---------------------------------------------------------------------------
+# GL002 — hidden host syncs / trace breaks in traced code
+
+_NP_ALLOWED = frozenset({
+    # dtype constructors and shape introspection are trace-safe
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "dtype", "ndim",
+    "shape", "isscalar", "broadcast_shapes",
+})
+
+
+def check_gl002(module: ModuleInfo) -> Iterator[Violation]:
+    for node in _walk_traced(module):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name and name.startswith(("np.", "numpy.")):
+            if name.startswith(("np.random.", "numpy.random.")):
+                continue  # GL001's domain
+            if _terminal(name) not in _NP_ALLOWED:
+                yield Violation(
+                    module.path, node.lineno, node.col_offset, "GL002",
+                    f"raw numpy call `{name}(...)` inside traced code: "
+                    "on a traced value this breaks the trace (or "
+                    "silently bakes in a host constant) and forces a "
+                    "device->host sync; use jnp/lax")
+            continue
+        if name in ("jax.device_get", "device_get"):
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL002",
+                "`jax.device_get` inside traced code is a host sync; "
+                "return the value and materialize it outside the "
+                "traced function")
+            continue
+        if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+                and not node.args and not node.keywords):
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL002",
+                "`.item()` inside traced code is a trace break / host "
+                "sync; keep the value as an array")
+            continue
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and isinstance(node.args[0], (ast.Call, ast.Subscript))):
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL002",
+                f"`{node.func.id}(...)` of a computed value inside "
+                "traced code concretizes a tracer (host sync / "
+                "ConcretizationTypeError); keep it as an array or hoist "
+                "it out of the traced function")
+
+
+# ---------------------------------------------------------------------------
+# GL003 — PRNG key reuse across draws
+
+_KEY_NONDRAWS = frozenset({
+    "PRNGKey", "key", "split", "fold_in", "key_data", "wrap_key_data",
+    "key_impl", "clone",
+})
+
+
+def _jax_random_aliases(tree: ast.Module) -> Set[str]:
+    """Local names that refer to the jax.random module: 'jax.random'
+    always; plus whatever `from jax import random [as r]` / `import
+    jax.random as jr` bind. Plain `import random` (stdlib) never
+    qualifies, so stdlib draws don't masquerade as key consumption."""
+    aliases = {"jax.random"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "random":
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    aliases.add(a.asname)
+    return aliases
+
+
+def _is_jax_random(name: Optional[str], aliases: Set[str]) -> bool:
+    if not name or "." not in name:
+        return False
+    return name.rsplit(".", 1)[0] in aliases
+
+
+def check_gl003(module: ModuleInfo) -> Iterator[Violation]:
+    aliases = _jax_random_aliases(module.tree)
+    funcs = [n for n in ast.walk(module.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _owner(node: ast.AST) -> Optional[ast.AST]:
+        """The nearest enclosing def, looking THROUGH lambdas (they
+        cannot rebind names, and a draw inside `vmap(lambda i: ...)`
+        genuinely consumes the enclosing scope's key)."""
+        for f in module.enclosing_functions(node):
+            if not isinstance(f, ast.Lambda):
+                return f
+        return None
+
+    for fn in funcs:
+        # Per-scope linear scan: only nodes whose owning def is `fn`
+        # participate — a nested def is a separate binding scope (its
+        # assignments must not clear the outer drawn set, and it gets
+        # its own pass from the `funcs` list). Cross-scope reuse
+        # (outer draw + closure draw on the same outer key) is out of
+        # scope for this rule — precision over recall.
+        # events in source order: (lineno, col, kind, varname)
+        events: List[Tuple[int, int, str, str]] = []
+        for node in ast.walk(fn):
+            if node is fn or _owner(node) is not fn:
+                continue
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    for name_node in ast.walk(tgt):
+                        if isinstance(name_node, ast.Name):
+                            events.append((node.lineno, node.col_offset,
+                                           "assign", name_node.id))
+            elif isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if not _is_jax_random(name, aliases):
+                    continue
+                if _terminal(name) in _KEY_NONDRAWS:
+                    continue
+                # a draw: jax.random.normal(key, ...) — first positional
+                # arg (or key=...) names the consumed key
+                key_arg = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "key"), None)
+                if isinstance(key_arg, ast.Name):
+                    events.append((node.lineno, node.col_offset,
+                                   "draw", key_arg.id))
+        drawn: Set[str] = set()
+        for lineno, col, kind, name in sorted(events):
+            if kind == "assign":
+                drawn.discard(name)
+            elif kind == "draw":
+                if name in drawn:
+                    yield Violation(
+                        module.path, lineno, col, "GL003",
+                        f"PRNG key `{name}` consumed by a second draw "
+                        "without an intervening split/fold_in: the two "
+                        "draws are perfectly correlated. fold_in a "
+                        "distinct domain tag (the dropout-vs-straggler "
+                        "discipline of utils/faults) or split the key")
+                drawn.add(name)
+
+
+# ---------------------------------------------------------------------------
+# GL004 — Python control flow over traced values
+
+_ARRAY_REDUCERS = frozenset({"any", "all", "sum", "mean", "max", "min",
+                             "prod", "item"})
+
+
+def _traced_value_expr(expr: ast.AST) -> Optional[str]:
+    """A sub-expression that clearly produces a traced array value:
+    a jnp./jax.numpy./jax.lax. call, or an array-reducer method call."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name and name.startswith(("jnp.", "jax.numpy.", "jax.lax.",
+                                     "lax.")):
+            return name
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ARRAY_REDUCERS
+                and not node.args):
+            base = _dotted(node.func.value)
+            # cfg.*, self.* etc. are host objects; bare names and
+            # computed bases are the array case
+            if base is None or "." not in base:
+                return f".{node.func.attr}()"
+    return None
+
+
+def check_gl004(module: ModuleInfo) -> Iterator[Violation]:
+    for node in _walk_traced(module):
+        if isinstance(node, (ast.If, ast.While)):
+            hit = _traced_value_expr(node.test)
+            if hit:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield Violation(
+                    module.path, node.lineno, node.col_offset, "GL004",
+                    f"Python `{kind}` over a traced value ({hit}): this "
+                    "forces a trace-time concretization (or a silent "
+                    "per-value retrace); use lax.cond / lax.select / "
+                    "jnp.where" + (" / lax.while_loop"
+                                   if kind == "while" else ""))
+        elif isinstance(node, ast.For):
+            hit = _traced_value_expr(node.iter)
+            if hit:
+                yield Violation(
+                    module.path, node.lineno, node.col_offset, "GL004",
+                    f"Python `for` over a traced value ({hit}): the loop "
+                    "unrolls at trace time (program size scales with "
+                    "the array) or fails to concretize; use lax.scan / "
+                    "lax.fori_loop")
+        elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "bool" and len(node.args) == 1
+                and _traced_value_expr(node.args[0])):
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL004",
+                "`bool(...)` of a traced value concretizes the tracer; "
+                "use lax.cond / jnp.where")
+
+
+# ---------------------------------------------------------------------------
+# GL005 — fault-swallowing broad except handlers
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _names_broad(type_expr: Optional[ast.expr]) -> bool:
+    if type_expr is None:
+        return True  # bare `except:`
+    if isinstance(type_expr, ast.Tuple):
+        return any(_names_broad(e) for e in type_expr.elts)
+    return _terminal(_dotted(type_expr)) in _BROAD
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body contains a bare `raise` (re-raise) at
+    any depth — the sanctioned cleanup-then-reraise and
+    classify-then-reraise idioms (multihost.initialize, utils/retry)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return True
+    return False
+
+
+def check_gl005(module: ModuleInfo) -> Iterator[Violation]:
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _names_broad(node.type) and not _reraises(node):
+            caught = (module.segment(node.type) if node.type is not None
+                      else "<bare>")
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL005",
+                f"broad `except {caught}` without re-raise would swallow "
+                "InjectedFault and defeat the fault harness (and mask "
+                "real config errors as transients); catch the specific "
+                "expected exceptions, or re-raise")
+
+
+# ---------------------------------------------------------------------------
+# GL006 — non-atomic file writes
+
+_WRITE_MODES = ("w", "a", "x", "+")
+
+
+def _enclosing_scope_calls_replace(module: ModuleInfo,
+                                   node: ast.AST) -> bool:
+    scope: ast.AST = module.tree
+    for fn in module.enclosing_functions(node):
+        scope = fn
+        break
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call) and _dotted(n.func) in (
+                "os.replace", "os.rename"):
+            return True
+    return False
+
+
+def _mentions_tmp(module: ModuleInfo, expr: ast.AST) -> bool:
+    return "tmp" in module.segment(expr).lower()
+
+
+def check_gl006(module: ModuleInfo) -> Iterator[Violation]:
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name in ("open", "io.open") or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "open" and name is None):
+            mode = None
+            if len(node.args) >= 2:
+                mode = node.args[1]
+            else:
+                mode = next((kw.value for kw in node.keywords
+                             if kw.arg == "mode"), None)
+            if not (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and any(ch in mode.value for ch in _WRITE_MODES)):
+                continue
+            target = node.args[0] if node.args else None
+            if target is None or _mentions_tmp(module, target):
+                continue
+            if _enclosing_scope_calls_replace(module, node):
+                continue
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL006",
+                "open-for-write without the atomic `.tmp` + os.replace "
+                "pattern (utils/atomic_io): a preemption mid-write "
+                "corrupts the previous file in place; write to "
+                "`<path>.tmp` and os.replace, or use "
+                "atomic_write_text/atomic_savez")
+        elif name in ("np.save", "np.savez", "np.savez_compressed",
+                      "numpy.save", "numpy.savez",
+                      "numpy.savez_compressed"):
+            target = node.args[0] if node.args else None
+            # a bare Name is typically an open file handle (already
+            # routed through the atomic open) or a precomputed tmp path
+            if target is None or isinstance(target, ast.Name):
+                continue
+            if _mentions_tmp(module, target):
+                continue
+            if _enclosing_scope_calls_replace(module, node):
+                continue
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL006",
+                f"`{name}` straight to its destination path: a "
+                "preemption mid-serialize leaves a torn archive under "
+                "the real name; use utils/atomic_io.atomic_savez")
+
+
+# ---------------------------------------------------------------------------
+
+ALL_RULES = {
+    "GL001": check_gl001,
+    "GL002": check_gl002,
+    "GL003": check_gl003,
+    "GL004": check_gl004,
+    "GL005": check_gl005,
+    "GL006": check_gl006,
+}
+
+RULE_DOCS = {
+    "GL001": "host nondeterminism (clocks, unseeded global RNG) inside "
+             "traced code",
+    "GL002": "raw numpy / .item() / device_get inside traced code "
+             "(hidden sync, trace break)",
+    "GL003": "PRNG key consumed by two draws without split/fold_in "
+             "domain separation",
+    "GL004": "Python if/while/for over traced values where "
+             "lax.cond/scan is required",
+    "GL005": "broad except handler that would swallow InjectedFault "
+             "(no re-raise)",
+    "GL006": "file write without the atomic .tmp + os.replace pattern",
+}

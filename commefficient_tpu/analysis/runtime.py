@@ -1,0 +1,134 @@
+"""Runtime sanitizers: the dynamic half of graftlint.
+
+The static pass (engine/rules) catches what syntax can prove; these
+context managers catch what only execution can — armed by the test
+suite so the round engine's two load-bearing runtime contracts are
+EXECUTED checks, not prose:
+
+  * `assert_program_count(n)` — a compilation counter around a block.
+    ROADMAP's "exactly three traced round programs" (mask-free,
+    dropout, dropout+stragglers) becomes `with
+    assert_program_count(3): <run all three configs twice>`: a fourth
+    program (an accidental retrace from a new treedef, a weak-type
+    flip-flop, a shape leak) fails the block. Counting is a pair of
+    jax.monitoring listeners (backend-compile durations + compilation-
+    cache requests, max of the two — robust whether the compilation
+    cache is enabled, disabled, or hitting its persistent store) — no
+    monkeypatching, counts executable builds (tracing-cache hits and
+    C++ fast-path dispatches are free, as they must be).
+  * `forbid_transfers()` — `jax.transfer_guard("disallow")` around a
+    block: any IMPLICIT host<->device transfer (an `np.asarray` of a
+    device array, a python-scalar operand materialized at dispatch, a
+    stray `float()`) raises. Explicit `jax.device_put`/`device_get`
+    stay legal — the framework's host boundaries (multihost.globalize
+    / gather_host) are deliberately explicit so a guarded round is
+    provably sync-free everywhere else.
+
+The `sanitize` pytest fixture (tests/conftest.py) hands tests a
+`Sanitizer` exposing both.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+# Two redundant per-program signals, counted independently; the block
+# count is their max. Each fires once per distinct executable and
+# never on tracing-cache hits or C++ fast-path dispatches:
+#   * backend_compile_duration — one per XLA backend compile,
+#     unconditionally (fires even with the compilation cache disabled,
+#     where the cache-request event below never records);
+#   * compile_requests_use_cache — one per compile request when the
+#     cache is consulted (covers persistent-cache HITS, where a
+#     distinct program loads without a backend compile).
+_COMPILE_EVENTS = frozenset({
+    "/jax/compilation_cache/compile_requests_use_cache",
+})
+_COMPILE_DURATION_EVENTS = frozenset({
+    "/jax/core/compile/backend_compile_duration",
+})
+
+_counter = {"requests": 0, "backend": 0, "installed": False}
+
+
+def _on_event(event: str, **kw) -> None:
+    if event in _COMPILE_EVENTS:
+        _counter["requests"] += 1
+
+
+def _on_event_duration(event: str, duration: float, **kw) -> None:
+    if event in _COMPILE_DURATION_EVENTS:
+        _counter["backend"] += 1
+
+
+def _ensure_listener() -> None:
+    if not _counter["installed"]:
+        jax.monitoring.register_event_listener(_on_event)
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        _counter["installed"] = True
+
+
+class ProgramCount:
+    """Result handle of `count_programs`: `.count` is the number of
+    programs compiled inside the block (live-updating during it)."""
+
+    def __init__(self, start_requests: int, start_backend: int):
+        self._start_requests = start_requests
+        self._start_backend = start_backend
+
+    @property
+    def count(self) -> int:
+        return max(_counter["requests"] - self._start_requests,
+                   _counter["backend"] - self._start_backend)
+
+
+@contextlib.contextmanager
+def count_programs():
+    """Count XLA executables built inside the block."""
+    _ensure_listener()
+    yield ProgramCount(_counter["requests"], _counter["backend"])
+
+
+@contextlib.contextmanager
+def assert_program_count(n: int):
+    """Assert EXACTLY `n` programs compile inside the block.
+
+    Build every operand (device arrays, keys, lr scalars) BEFORE the
+    block: eager jnp ops compile their own tiny programs and would
+    inflate the count. A block observing 0 when n > 0 usually means the
+    workload was warmed up beforehand — this sanitizer wants the cold
+    calls inside."""
+    with count_programs() as c:
+        yield c
+    got = c.count
+    if got != n:
+        if got > n:
+            why = ("an extra program means an accidental retrace (new "
+                   "treedef/shape/dtype or weak-type flip) — the "
+                   "three-programs contract of federated/round.py caps "
+                   "dispatch cost")
+        else:
+            why = ("fewer means the block was pre-warmed or the "
+                   "workload never ran")
+        raise AssertionError(
+            f"program-count contract violated: expected exactly {n} "
+            f"compiled program(s) in this block, observed {got}; {why} "
+            "(see analysis/runtime.py)")
+
+
+@contextlib.contextmanager
+def forbid_transfers():
+    """Disallow implicit host<->device transfers inside the block
+    (explicit jax.device_put / jax.device_get remain legal)."""
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+class Sanitizer:
+    """What the `sanitize` pytest fixture hands a test."""
+
+    count_programs = staticmethod(count_programs)
+    assert_program_count = staticmethod(assert_program_count)
+    forbid_transfers = staticmethod(forbid_transfers)

@@ -26,6 +26,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.utils.atomic_io import atomic_save, atomic_savez
 
 
 def _try_load_cifar_pickles(root: str, name: str):
@@ -127,7 +128,9 @@ class FedCIFAR10(FedDataset):
             import json
             with open(self.stats_path()) as f:
                 stats = json.load(f)
-        except Exception:
+        except (OSError, ValueError):
+            # missing/unreadable/torn stats file -> re-prepare; anything
+            # else (incl. InjectedFault from the fault harness) raises
             return False
         have_pickles = _try_load_cifar_pickles(
             self.dataset_dir, self.dataset_name) is not None
@@ -167,10 +170,11 @@ class FedCIFAR10(FedDataset):
         images_per_client = []
         for c in range(self.num_classes):
             sel = ytr == c
-            np.save(os.path.join(self._dir(), f"client{c}.npy"), xtr[sel])
+            atomic_save(os.path.join(self._dir(), f"client{c}.npy"),
+                        xtr[sel])
             images_per_client.append(int(sel.sum()))
-        np.savez(os.path.join(self._dir(), "val.npz"),
-                 images=xva, labels=yva)
+        atomic_savez(os.path.join(self._dir(), "val.npz"),
+                     images=xva, labels=yva)
         # the source + generator-version stamp is what
         # _cached_stats_ok uses to invalidate a cache that is stale
         # (v1 corpus) or of the wrong provenance (synthetic .npy left

@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.utils.atomic_io import atomic_savez
 
 NUM_CLASSES = 62
 HW = 28
@@ -104,7 +105,9 @@ class FedEMNIST(FedDataset):
             import json
             with open(self.stats_path()) as f:
                 stats = json.load(f)
-        except Exception:
+        except (OSError, ValueError):
+            # missing/unreadable/torn stats file -> re-prepare; anything
+            # else (incl. InjectedFault from the fault harness) raises
             return False
         if os.path.isdir(os.path.join(self._dir(), "raw", "train")):
             return stats.get("source") == "leaf"
@@ -146,9 +149,9 @@ class FedEMNIST(FedDataset):
         targets = np.concatenate([y for _, y in train])
         offsets = np.concatenate(
             [[0], np.cumsum([len(y) for _, y in train])])
-        np.savez(self._npz_path("train"), images=images, targets=targets,
-                 offsets=offsets)
-        np.savez(self._npz_path("val"), images=vx, labels=vy)
+        atomic_savez(self._npz_path("train"), images=images,
+                     targets=targets, offsets=offsets)
+        atomic_savez(self._npz_path("val"), images=vx, labels=vy)
         from_leaf = os.path.isdir(raw_train)
         self.write_stats(
             [len(y) for _, y in train], len(vy),

@@ -18,6 +18,8 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from commefficient_tpu.utils.atomic_io import atomic_write_text
+
 
 class FedDataset:
     """Base class: a train corpus partitioned over clients, plus a flat
@@ -75,8 +77,10 @@ class FedDataset:
                  "num_val_images": int(num_val_images)}
         if extra:
             stats.update(extra)
-        with open(self.stats_path(), "w") as f:
-            json.dump(stats, f)
+        # atomic (GL006): a preemption mid-write must not leave a torn
+        # stats file shadowing an intact cache — _cached_stats_ok would
+        # read garbage and re-prepare over good data
+        atomic_write_text(self.stats_path(), json.dumps(stats))
 
     def _load_meta(self):
         with open(self.stats_path()) as f:

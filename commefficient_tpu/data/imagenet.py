@@ -23,6 +23,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.utils.atomic_io import atomic_save, atomic_savez
 
 NUM_CLASSES = 1000
 
@@ -68,7 +69,9 @@ class FedImageNet(FedDataset):
             import json
             with open(self.stats_path()) as f:
                 stats = json.load(f)
-        except Exception:
+        except (OSError, ValueError):
+            # missing/unreadable/torn stats file -> re-prepare; anything
+            # else (incl. InjectedFault from the fault harness) raises
             return False
         n_train, n_val = self._synthetic_examples
         n_cls = min(NUM_CLASSES, 16)
@@ -127,13 +130,13 @@ class FedImageNet(FedDataset):
         for c in range(n_cls):
             x = np.clip(templates[c] + rng.randn(per, hw, hw, 3) * 0.1,
                         0, 1)
-            np.save(self._pre(f"client{c}.npy"),
-                    (x * 255).astype(np.uint8))
+            atomic_save(self._pre(f"client{c}.npy"),
+                        (x * 255).astype(np.uint8))
             counts.append(per)
         yv = rng.randint(0, n_cls, n_val)
         xv = np.clip(templates[yv] + rng.randn(n_val, hw, hw, 3) * 0.1, 0, 1)
-        np.savez(self._pre("val.npz"), images=(xv * 255).astype(np.uint8),
-                 labels=yv)
+        atomic_savez(self._pre("val.npz"),
+                     images=(xv * 255).astype(np.uint8), labels=yv)
         self.write_stats(counts, n_val,
                          extra={"source": "synthetic",
                                 "synthetic_version": _SYNTH_VERSION})

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.utils.atomic_io import atomic_savez
 
 SPECIAL_TOKENS = ("<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>")
 IGNORE_INDEX = -1
@@ -101,7 +102,12 @@ def make_tokenizer(model_checkpoint: str = "gpt2",
     """GPT2 BPE when locally cached, HashTokenizer otherwise."""
     try:
         return GPT2BPETokenizer(model_checkpoint)
-    except Exception:
+    except (ImportError, OSError, ValueError, RuntimeError, TypeError):
+        # transformers missing / no locally-cached vocab files / torn
+        # cache — the expected offline failure modes. TypeError is on
+        # the list because transformers resolves missing cached vocab
+        # files to None and dies in open(None). Anything else (incl.
+        # InjectedFault from the fault harness) raises.
         return HashTokenizer(fallback_vocab)
 
 
@@ -399,9 +405,9 @@ class FedPERSONA(FedDataset):
                 counts.append(n_utt)
                 start += nd
             arrays["offsets"] = np.concatenate([[0], np.cumsum(counts)])
-            np.savez(self._npz_path(split), **arrays)
+            atomic_savez(self._npz_path(split), **arrays)
             return counts
-        np.savez(self._npz_path(split), **arrays)
+        atomic_savez(self._npz_path(split), **arrays)
         return N
 
     # ---- fetch ----------------------------------------------------------

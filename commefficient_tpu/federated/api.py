@@ -526,11 +526,15 @@ class FedModel:
 
         download = np.zeros(self.num_clients)
         upload = np.zeros(self.num_clients)
-        bits_host = np.asarray(bits)
+        # explicit device_get (not np.asarray): run_rounds is
+        # transfer-guard-clean end to end — tests arm
+        # analysis/runtime.forbid_transfers around the whole call
+        bits_host = jax.device_get(bits)
         if self._prev_change_words is not None:
             # may still be a device array from a preceding single-round
             # call (the lazy-sync path in _call_train)
-            self._prev_change_words = np.asarray(self._prev_change_words)
+            self._prev_change_words = jax.device_get(
+                self._prev_change_words)
         for n in range(ids_host.shape[0]):
             surv_n = None if surv_all is None else surv_all[n]
             if account:

@@ -395,7 +395,8 @@ def save_pretrained(log_dir: str, params, cfg: GPT2Config,
         # torch-less environment: same state dict, npz container (the
         # artifact still round-trips through load_pretrained_dir; only
         # stock-transformers interop needs the .bin)
-        np.savez(os.path.join(log_dir, "pytorch_model.npz"), **hf_sd)
+        from commefficient_tpu.utils.atomic_io import atomic_savez
+        atomic_savez(os.path.join(log_dir, "pytorch_model.npz"), **hf_sd)
     conf = {
         "model_type": "gpt2",
         "architectures": ["GPT2DoubleHeadsModel"],
@@ -408,18 +409,19 @@ def save_pretrained(log_dir: str, params, cfg: GPT2Config,
         "layer_norm_epsilon": cfg.layer_norm_epsilon,
         "initializer_range": cfg.initializer_range,
     }
-    with open(os.path.join(log_dir, "config.json"), "w") as f:
-        json.dump(conf, f, indent=1)
+    from commefficient_tpu.utils.atomic_io import atomic_write_text
+    atomic_write_text(os.path.join(log_dir, "config.json"),
+                      json.dumps(conf, indent=1))
     if tokenizer is not None:
         inner = getattr(tokenizer, "tok", tokenizer)
         if hasattr(inner, "save_pretrained"):
             inner.save_pretrained(log_dir)
         else:
             # offline HashTokenizer: record enough to rebuild it
-            with open(os.path.join(log_dir, "tokenizer_config.json"),
-                      "w") as f:
-                json.dump({"tokenizer_class": "HashTokenizer",
-                           "vocab_size": len(tokenizer)}, f)
+            atomic_write_text(
+                os.path.join(log_dir, "tokenizer_config.json"),
+                json.dumps({"tokenizer_class": "HashTokenizer",
+                            "vocab_size": len(tokenizer)}))
     return log_dir
 
 
@@ -468,6 +470,9 @@ def try_load_pretrained(model_checkpoint: str, cfg: GPT2Config,
         from transformers import GPT2LMHeadModel
         pt = GPT2LMHeadModel.from_pretrained(
             model_checkpoint, local_files_only=True)
-    except Exception:
+    except (ImportError, OSError, ValueError, RuntimeError):
+        # transformers/torch missing, no locally-cached checkpoint, or
+        # a torn cache — the expected offline failure modes; anything
+        # else (incl. InjectedFault from the fault harness) raises
         return None
     return params_from_hf_state_dict(pt.state_dict(), cfg, key=key)

@@ -98,16 +98,24 @@ def initialize(coordinator_address: Optional[str] = None,
     def attempt():
         try:
             jax.distributed.initialize(**kw)
-        except Exception:
-            # jax assigns its global client (and rank 0's coordination
-            # service) BEFORE connect(), so a failed connect leaves
-            # half-initialized state that would make the next call
-            # raise 'initialize should only be called once' — a fatal-
-            # looking error masking the real timeout. Tear it down
-            # best-effort so the retry is a genuine fresh attempt.
+        except (RuntimeError, ValueError, OSError):
+            # The expected rendezvous failure classes: XlaRuntimeError
+            # (a RuntimeError) from gRPC timeouts/refusals, ValueError
+            # from bad grids, OSError/ConnectionError from the socket
+            # layer. jax assigns its global client (and rank 0's
+            # coordination service) BEFORE connect(), so a failed
+            # connect leaves half-initialized state that would make the
+            # next call raise 'initialize should only be called once' —
+            # a fatal-looking error masking the real timeout. Tear it
+            # down best-effort so the retry is a genuine fresh attempt,
+            # then re-raise for with_retries' transient/fatal triage.
+            # Anything outside these classes (incl. InjectedFault)
+            # propagates untouched, per GL005.
             try:
                 jax.distributed.shutdown()
-            except Exception:
+            except (RuntimeError, ValueError, OSError):
+                # a half-initialized client may have nothing to shut
+                # down; the original connect error is the one to surface
                 pass
             raise
 
@@ -162,7 +170,14 @@ def globalize(mesh: Mesh, spec: P, value) -> jax.Array:
     value — correct for any device→process layout."""
     sharding = NamedSharding(mesh, spec)
     if not is_multihost():
-        return jax.device_put(jnp.asarray(value), sharding)
+        # EXPLICIT placement (device_put of a host array or an
+        # already-device array): the jitted-round transfer-guard
+        # contract (analysis/runtime.forbid_transfers) allows explicit
+        # transfers only, so the host boundary must never go through an
+        # implicit jnp.asarray of host data
+        if not isinstance(value, jax.Array):
+            value = np.asarray(value)
+        return jax.device_put(value, sharding)
     arr = np.asarray(value)
     return jax.make_array_from_callback(
         arr.shape, sharding, lambda idx: arr[idx])
@@ -182,7 +197,10 @@ def shard_rows(mesh: Mesh, local_rows, leading_axes: int = 0) -> jax.Array:
              *([None] * (np.ndim(local_rows) - leading_axes - 1)))
     sharding = NamedSharding(mesh, spec)
     if not is_multihost():
-        return jax.device_put(jnp.asarray(local_rows), sharding)
+        # explicit placement — see globalize
+        if not isinstance(local_rows, jax.Array):
+            local_rows = np.asarray(local_rows)
+        return jax.device_put(local_rows, sharding)
     return jax.make_array_from_process_local_data(
         sharding, np.asarray(local_rows))
 
@@ -262,7 +280,9 @@ def zeros(mesh: Mesh, spec: P, shape: Tuple[int, ...],
     never materialize host-globally."""
     sharding = NamedSharding(mesh, spec)
     if not is_multihost():
-        return jax.device_put(jnp.zeros(shape, dtype), sharding)
+        # host-side np.zeros + explicit device_put: no throwaway
+        # device-default placement to reshard, no implicit transfer
+        return jax.device_put(np.zeros(shape, np.dtype(dtype)), sharding)
     return jax.make_array_from_callback(
         tuple(shape), sharding,
         lambda idx: np.zeros(_shard_shape(idx, shape), dtype))
@@ -276,8 +296,8 @@ def tile_rows(mesh: Mesh, vec, rows: int) -> jax.Array:
     shape = (rows, host.shape[0])
     sharding = NamedSharding(mesh, P("clients", None))
     if not is_multihost():
-        return jax.device_put(
-            jnp.broadcast_to(jnp.asarray(host), shape), sharding)
+        # np.broadcast_to + explicit device_put — see globalize
+        return jax.device_put(np.broadcast_to(host, shape), sharding)
 
     def cb(idx):
         return np.broadcast_to(host[idx[1]],
@@ -296,12 +316,14 @@ def _shard_shape(idx: Tuple[slice, ...], shape: Tuple[int, ...]):
 
 def gather_host(x) -> np.ndarray:
     """Materialize a (possibly cross-process-sharded) device array on
-    every host. Identity (``np.asarray``) when the array is fully
-    addressable; ``process_allgather`` otherwise."""
+    every host. An EXPLICIT ``jax.device_get`` when the array is fully
+    addressable (so a transfer-guarded round may call this — implicit
+    ``np.asarray`` of a device array would trip the guard);
+    ``process_allgather`` otherwise."""
     if isinstance(x, np.ndarray) or np.isscalar(x):
         return np.asarray(x)
     if getattr(x, "is_fully_addressable", True) or _fully_replicated(x):
-        return np.asarray(x)
+        return jax.device_get(x)
     from jax.experimental import multihost_utils
     return multihost_utils.process_allgather(x, tiled=True)
 

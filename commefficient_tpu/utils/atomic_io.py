@@ -1,0 +1,61 @@
+"""Atomic file writes: the `.tmp` + os.replace pattern, shared.
+
+utils/checkpoint.py established the discipline (every checkpoint byte
+lands in `<path>.tmp` and only a successful flush is os.replace'd over
+the real name, so a preemption mid-write can never corrupt the
+previous file) and graftlint rule GL006 now enforces it mechanically
+across the tree. This module is the one sanctioned implementation —
+checkpoints, dataset caches, and exported configs all route through
+it instead of growing private near-copies.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Write `text` to `path` atomically (flush + fsync + replace)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_save(path: str, arr) -> None:
+    """np.save to `path` atomically. Like atomic_savez, the tmp file is
+    opened explicitly so np.save cannot append `.npy` to the tmp name —
+    the final name is exactly `path`."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_savez(path: str, **arrays) -> None:
+    """np.savez to `path` atomically.
+
+    np.savez appends `.npz` to extension-less PATHS but not to open
+    FILE handles, so the tmp file is opened here explicitly — the
+    final name is exactly `path` (callers pass the full .npz name,
+    matching the direct np.savez(path) behavior this replaces)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# graftlint: the repo's trace-safety static-analysis pass (rules
+# GL001-GL006, see README "Invariants & graftlint"). Runs from any cwd;
+# extra args pass through (e.g. `bash scripts/lint.sh --list-rules`,
+# `--no-baseline`, `--write-baseline`).
+#
+# Deliberately jax-free: the engine is pure-ast, so this runs on boxes
+# with no accelerator and costs no device state.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m commefficient_tpu.analysis "$@"

@@ -8,6 +8,10 @@
 # Exit code is pytest's; the DOTS_PASSED line is the driver's pass
 # counter (count of '.' progress dots in the captured log).
 set -o pipefail
+# trace-safety lint first (fast, pure-ast, no device): a GL violation
+# fails tier-1 before any test runs — its log stays out of the pytest
+# capture below so DOTS_PASSED counting is unaffected
+bash "$(dirname "$0")/lint.sh" || { echo "GRAFTLINT_FAILED"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -38,6 +38,20 @@ def mesh():
 
 
 @pytest.fixture
+def sanitize():
+    """Runtime sanitizers (analysis/runtime): `forbid_transfers()` —
+    jax.transfer_guard("disallow") proving a block performs zero
+    implicit host transfers — and `assert_program_count(n)` — a
+    compilation counter enforcing the round engine's three-programs
+    contract. Both are context managers; arm them around the device
+    dispatch, build operands (device arrays, jnp lr scalars, keys)
+    BEFORE the block, and read results AFTER it."""
+    from commefficient_tpu.analysis.runtime import Sanitizer
+
+    return Sanitizer()
+
+
+@pytest.fixture
 def ckpt_dir(tmp_path):
     """Isolated checkpoint directory per test: checkpoint/rotation
     tests never see each other's manifests or stamped files."""

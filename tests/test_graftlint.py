@@ -1,0 +1,359 @@
+"""graftlint: one failing (positive) and one passing (negative)
+fixture snippet per rule GL001-GL006, the suppression/baseline
+machinery, and positive controls for the runtime sanitizers — so the
+enforcement layer itself can't silently rot (a lint whose rules stop
+firing is worse than no lint: it keeps certifying the tree clean)."""
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.analysis.engine import (
+    Baseline, LintError, Violation, lint_paths, lint_source,
+)
+from commefficient_tpu.analysis.rules import ALL_RULES, RULE_DOCS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(src: str):
+    return sorted({v.rule for v in lint_source("snippet.py",
+                                               textwrap.dedent(src))})
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: positive (must fire) and negative (must stay quiet)
+
+GL001_POS = """
+    import time, jax
+
+    @jax.jit
+    def f(x):
+        return x * time.time()
+"""
+GL001_NEG = """
+    import time, jax
+
+    def host_timer():
+        # wall-clock timing OUTSIDE traced code is legal (drivers'
+        # epoch timing, checkpoint age GC)
+        return time.time()
+
+    @jax.jit
+    def f(x):
+        return x * 2.0
+"""
+
+GL002_POS = """
+    import numpy as np, jax
+
+    @jax.jit
+    def f(x):
+        return np.asarray(x).sum()
+"""
+GL002_NEG = """
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.asarray(x).sum()
+"""
+
+GL003_POS = """
+    import jax
+
+    def f():
+        key = jax.random.PRNGKey(0)
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,))
+        return a + b
+"""
+GL003_NEG = """
+    import jax
+
+    def f():
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (3,))
+        b = jax.random.uniform(k2, (3,))
+        return a + b
+"""
+
+GL004_POS = """
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        if jnp.any(x > 0):
+            return x
+        return -x
+"""
+GL004_NEG = """
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x, mode: str = "abs"):
+        # static (trace-time) Python branching over config is legal —
+        # it's how round.py selects its three programs
+        if mode == "abs":
+            return jnp.abs(x)
+        return jax.lax.cond(True, lambda v: v, lambda v: -v, x)
+"""
+
+GL005_POS = """
+    def f():
+        try:
+            g()
+        except Exception:
+            return None
+"""
+GL005_NEG = """
+    def f():
+        try:
+            g()
+        except (OSError, ValueError):
+            return None
+
+    def h():
+        try:
+            g()
+        except Exception:
+            cleanup()
+            raise
+"""
+
+GL006_POS = """
+    def save(path, text):
+        with open(path, "w") as f:
+            f.write(text)
+"""
+GL006_NEG = """
+    import os
+
+    def save(path, text):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+    def read(path):
+        with open(path) as f:
+            return f.read()
+"""
+
+FIXTURES = {
+    "GL001": (GL001_POS, GL001_NEG),
+    "GL002": (GL002_POS, GL002_NEG),
+    "GL003": (GL003_POS, GL003_NEG),
+    "GL004": (GL004_POS, GL004_NEG),
+    "GL005": (GL005_POS, GL005_NEG),
+    "GL006": (GL006_POS, GL006_NEG),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(ALL_RULES))
+def test_rule_fires_on_positive_fixture(rule):
+    pos, _ = FIXTURES[rule]
+    assert rule in codes(pos), f"{rule} failed to fire on its fixture"
+
+
+@pytest.mark.parametrize("rule", sorted(ALL_RULES))
+def test_rule_quiet_on_negative_fixture(rule):
+    _, neg = FIXTURES[rule]
+    assert rule not in codes(neg), f"{rule} false-positived"
+
+
+def test_every_rule_documented():
+    assert set(RULE_DOCS) == set(ALL_RULES)
+
+
+# ---------------------------------------------------------------------------
+# traced-scope mechanics: GL001/2/4 apply inside traced code only,
+# including functions registered by call (scan/shard_map) and closures
+
+def test_traced_scope_via_scan_registration():
+    src = """
+        import numpy as np
+        import jax.lax as lax
+
+        def body(carry, x):
+            return carry + np.random.rand(), None
+
+        def run(xs):
+            return lax.scan(body, 0.0, xs)
+    """
+    assert "GL001" in codes(src)
+
+
+def test_nested_closure_inherits_traced_scope():
+    src = """
+        import jax
+
+        @jax.jit
+        def outer(x):
+            def inner(v):
+                return v.item()
+            return inner(x)
+    """
+    assert "GL002" in codes(src)
+
+
+def test_gl003_nested_def_rebind_does_not_mask_outer_reuse():
+    """A nested def rebinding `key` is a separate scope: it must not
+    clear the outer function's drawn-key tracking (code-review
+    regression — the nested assign used to discard the outer draw)."""
+    src = """
+        import jax
+
+        def outer(key):
+            a = jax.random.normal(key, (3,))
+
+            def inner(k2):
+                key = jax.random.fold_in(k2, 1)
+                return jax.random.normal(key, (3,))
+
+            b = jax.random.uniform(key, (3,))
+            return a + b + inner(key)
+    """
+    assert "GL003" in codes(src)
+
+
+def test_gl003_draw_inside_lambda_consumes_enclosing_key():
+    src = """
+        import jax
+
+        def f(key, xs):
+            a = jax.vmap(lambda i: jax.random.normal(key, (2,)))(xs)
+            b = jax.random.uniform(key, (3,))
+            return a, b
+    """
+    assert "GL003" in codes(src)
+
+
+def test_host_code_not_traced_scope():
+    src = """
+        import numpy as np
+
+        def host_only(x):
+            return float(np.asarray(x).sum())
+    """
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+
+def test_per_line_suppression_silences_rule():
+    src = """
+        import time, jax
+
+        @jax.jit
+        def f(x):
+            return x * time.time()  # graftlint: disable=GL001 -- test rig
+    """
+    assert codes(src) == []
+
+
+def test_suppression_is_rule_specific():
+    src = """
+        import time, jax
+
+        @jax.jit
+        def f(x):
+            return x * time.time()  # graftlint: disable=GL002
+    """
+    assert "GL001" in codes(src)
+
+
+def test_syntax_error_is_lint_error():
+    with pytest.raises(LintError):
+        lint_source("bad.py", "def f(:\n")
+
+
+def test_baseline_grandfathers_exact_counts():
+    vs = [Violation("a.py", 3, 0, "GL006", "m"),
+          Violation("a.py", 9, 0, "GL006", "m")]
+    base = Baseline({("a.py", "GL006"): (2, "legacy cache writes")})
+    new, stale = base.apply(vs)
+    assert new == [] and stale == []
+
+
+def test_baseline_reports_new_and_stale():
+    base = Baseline({("a.py", "GL006"): (2, "legacy")})
+    # tree improved: only one hit left -> stale entry must fail the run
+    new, stale = base.apply([Violation("a.py", 3, 0, "GL006", "m")])
+    assert new == [] and len(stale) == 1
+    # regression: a third hit -> the group surfaces
+    vs3 = [Violation("a.py", n, 0, "GL006", "m") for n in (3, 9, 12)]
+    new, stale = base.apply(vs3)
+    assert len(new) == 3  # whole group re-reported on overflow
+
+
+def test_shipped_baseline_exactly_matches_tree():
+    """The shipped baseline against a fresh scan of the shipped tree:
+    no new violations, no stale entries. New hits fail CI; grandfathered
+    ones (currently: none — the tree runs clean) don't."""
+    baseline_path = os.path.join(REPO, "graftlint.baseline.json")
+    with open(baseline_path) as f:
+        raw = json.load(f)
+    baseline = Baseline.load(baseline_path)
+    violations = lint_paths([os.path.join(REPO, "commefficient_tpu")])
+    # lint_paths reports repo-relative paths only when run from the
+    # repo root; normalize to the baseline's path convention
+    rel = [Violation(os.path.relpath(v.path, REPO).replace(os.sep, "/")
+                     if os.path.isabs(v.path) else v.path,
+                     v.line, v.col, v.rule, v.message)
+           for v in violations]
+    new, stale = baseline.apply(rel)
+    assert new == [], "\n".join(v.render() for v in new)
+    assert stale == [], "\n".join(stale)
+    assert raw["version"] == 1
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizers: positive controls
+
+def test_program_counter_counts_a_fresh_compile(sanitize):
+    with sanitize.count_programs() as c:
+        jax.jit(lambda x: x * 1.61803)(jnp.arange(5.0))
+    assert c.count >= 1
+
+
+def test_assert_program_count_rejects_extra_compiles(sanitize):
+    with pytest.raises(AssertionError, match="program-count"):
+        with sanitize.assert_program_count(0):
+            jax.jit(lambda x: x * 2.71828)(jnp.arange(6.0))
+
+
+def test_assert_program_count_allows_cache_hits(sanitize):
+    f = jax.jit(lambda x: x * 3.14159)
+    x = jnp.arange(7.0)
+    x2 = x + 0.0  # eager op compiled OUTSIDE the counted block
+    f(x)  # warm
+    with sanitize.assert_program_count(0):
+        f(x)
+        f(x2)  # same shape/dtype: cpp cache hit, no compile
+
+
+def test_forbid_transfers_blocks_implicit_host_to_device(sanitize):
+    # the host->device direction: an np operand materialized at
+    # dispatch is an implicit transfer. (On the CPU backend the
+    # device->host read direction is zero-copy and escapes the guard —
+    # on TPU it would trip too.)
+    f = jax.jit(lambda v: v + 1.0)
+    f(jnp.ones(3))  # warm with a device operand
+    with sanitize.forbid_transfers():
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            f(np.ones(3, np.float32))
+    f(np.ones(3, np.float32))  # legal again outside
+
+
+def test_forbid_transfers_allows_explicit_device_get(sanitize):
+    x = jnp.arange(4.0)
+    with sanitize.forbid_transfers():
+        host = jax.device_get(x)
+    np.testing.assert_array_equal(host, np.arange(4.0))

@@ -10,11 +10,23 @@ tunnel window lands. Pure-python/static checks: no device compute.
 """
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from commefficient_tpu.config import Config
 from commefficient_tpu.federated.server import args2sketch
 from commefficient_tpu.ops import flat
 from commefficient_tpu.ops.sketch import THRESHOLD_DECODE_MIN_D
+
+@pytest.fixture(autouse=True)
+def _no_transfers(sanitize):
+    """These gate checks are 'pure-python/static: no device compute' by
+    contract (module docstring) — arm the transfer guard over every
+    test so a refactor that sneaks device work (and its host<->device
+    traffic) into a gate predicate fails here, not on the next tunnel
+    window."""
+    with sanitize.forbid_transfers():
+        yield
+
 
 GPT2_D = 123_756_289      # GPT2-small double-heads (bench_gpt2.py)
 LTK_D = 5_252_388         # PreAct ResNet18 / CIFAR100 (bench_local_topk.py)

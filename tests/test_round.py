@@ -264,6 +264,90 @@ def test_topk_down_down_k_defaults_to_k():
     assert (cfg.replace(down_k=5).down_k or cfg.k) == 5
 
 
+def _sanitized_round_setup(mesh):
+    """Round fns + states + one RoundBatch per traced-program class —
+    mask-free, dropout, dropout+stragglers — with every operand
+    EXPLICITLY placed on the mesh the way FedModel places them
+    (multihost.globalize / shard_rows). The sanitizer contract: build
+    and place outside the guarded block, dispatch inside — an
+    uncommitted single-device operand would be implicitly resharded at
+    dispatch, which is exactly the class of hidden transfer the guard
+    exists to catch."""
+    from jax.sharding import PartitionSpec as P
+
+    from commefficient_tpu.parallel import multihost as mh
+
+    params = {"w": jnp.zeros(D)}
+    vec, unravel = flatten_params(params)
+    from commefficient_tpu.config import Config as _Config
+    cfg = _Config(mode="uncompressed", grad_size=D, weight_decay=0.0,
+                  num_workers=8, local_momentum=0.0,
+                  virtual_momentum=0.0, error_type="none",
+                  microbatch_size=-1, num_clients=8)
+    from commefficient_tpu.federated.round import make_round_fns
+    train_round, _ = make_round_fns(loss_fn, unravel, cfg, mesh)
+    from commefficient_tpu.federated.round import (
+        init_client_state, init_server_state,
+    )
+    server = init_server_state(cfg, vec, mesh=mesh)
+    clients = init_client_state(cfg, 8, vec, mesh=mesh)
+
+    _, x, y = make_problem()
+    ids = mh.globalize(mesh, P(), np.arange(8, dtype=np.int32))
+    data = (mh.shard_rows(mesh, np.asarray(x)),
+            mh.shard_rows(mesh, np.asarray(y)))
+    mask = mh.shard_rows(mesh, np.ones((8, 4), np.float32))
+    surv = mh.globalize(mesh, P(), np.array(
+        [1, 0, 1, 1, 1, 1, 0, 1], np.float32))
+    work = mh.globalize(mesh, P(), np.array(
+        [1, 1, 0.5, 1, 0.75, 1, 1, 0.25], np.float32))
+    batches = (RoundBatch(ids, data, mask),
+               RoundBatch(ids, data, mask, survivors=surv),
+               RoundBatch(ids, data, mask, survivors=surv, work=work))
+    lr = mh.globalize(mesh, P(), np.float32(0.1))
+    key = mh.globalize(mesh, P(), jax.random.PRNGKey(0))
+    return train_round, server, clients, batches, lr, key
+
+
+def test_exactly_three_round_programs(mesh, sanitize):
+    """ROADMAP's 'exactly three traced round programs' prose as an
+    executed check (analysis/runtime.assert_program_count): the
+    mask-free, dropout, and dropout+straggler configurations compile
+    one program each — and NOTHING else. A fourth program here is an
+    accidental retrace (new treedef/shape/weak-type leak), the exact
+    regression class the straggler work landed without."""
+    train_round, server, clients, batches, lr, key = (
+        _sanitized_round_setup(mesh))
+    with sanitize.assert_program_count(3):
+        for b in batches:
+            train_round(server, clients, b, lr, key)
+        # second sweep: every dispatch must be a cache hit
+        for b in batches:
+            train_round(server, clients, b, lr, key)
+
+
+def test_round_dispatch_zero_implicit_transfers(mesh, sanitize):
+    """The jitted round performs zero implicit host transfers in
+    steady state, across all three fault configurations: operands are
+    explicit device arrays, results stay on device until the caller
+    materializes them (outside the guard). An implicit transfer inside
+    the round is a hidden per-round host sync — the silent TPU
+    performance cliff GL002 hunts statically and this guard proves
+    dynamically."""
+    train_round, server, clients, batches, lr, key = (
+        _sanitized_round_setup(mesh))
+    for b in batches:  # compile outside the guard (steady-state claim)
+        train_round(server, clients, b, lr, key)
+    outs = []
+    with sanitize.forbid_transfers():
+        for b in batches:
+            s2, c2, m = train_round(server, clients, b, lr, key)
+            outs.append((s2, m))
+    for s2, m in outs:  # materialize only after the guard lifts
+        assert np.all(np.isfinite(np.asarray(s2.ps_weights)))
+        assert np.all(np.isfinite(np.asarray(m.losses)))
+
+
 def test_error_feedback_absorbs_approximate_topk(mesh, monkeypatch):
     """VERDICT r3 weak #6: on TPU `approx_max_k` recovers ~95% of the
     true top-k, and the safety argument is that error feedback

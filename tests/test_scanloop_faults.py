@@ -79,6 +79,41 @@ def _drive(model, stream, span_cap, checkpoint=None):
     return ok, emitted
 
 
+def test_scanned_span_zero_implicit_transfers(sanitize):
+    """FedModel.run_rounds — host staging, explicit feeds
+    (multihost.globalize/shard_rows), the scanned device program, the
+    accounting bitset device_get, the metric gathers — is
+    transfer-guard-clean END TO END: every host boundary is an
+    explicit device_put/device_get, so arming
+    analysis/runtime.forbid_transfers around the whole call proves the
+    span performs zero implicit host transfers. The first span
+    (dropout+straggler operands) compiles outside the guard; the
+    second span's faults are exhausted, so it traces AND compiles the
+    operand-free scanned program INSIDE the guard — even compilation
+    stays implicit-transfer-free."""
+    sched = FaultSchedule(drop_slots={1: [2]}, slow={2: {1: 0.5}})
+    model, _ = _fed_model()
+    model.set_fault_schedule(sched)
+    stream = _rounds(6)
+
+    def span_args(rounds):
+        ids = np.stack([r[1] for r in rounds])
+        data = tuple(np.stack([r[2][i] for r in rounds])
+                     for i in range(2))
+        mask = np.stack([r[3] for r in rounds])
+        lrs = np.asarray([r[4] for r in rounds], np.float32)
+        return ids, data, mask, lrs
+
+    # first span compiles the scanned program (compile-time constant
+    # placement is outside the steady-state claim)
+    model.run_rounds(*span_args(stream[:3]))
+    with sanitize.forbid_transfers():
+        out = model.run_rounds(*span_args(stream[3:]))
+    losses = out[0]
+    assert losses.shape == (3, 8)
+    assert np.all(np.isfinite(losses))
+
+
 # ---------------- dropout through the staging loop ------------------------
 
 def test_scanloop_dropout_matches_per_round_with_tail_span():
