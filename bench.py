@@ -553,12 +553,34 @@ def _last_tpu_note() -> str:
             f"vs_baseline {rec.get('vs_baseline')})")
 
 
+def journal_digest(out, kind):
+    """Append a bench digest to the shared telemetry journal (ISSUE 4
+    satellite: BENCH_*.json records and training runs share one
+    versioned JSONL schema — telemetry/journal.py). Path comes from
+    BENCH_JOURNAL (set it to 0 to disable), defaulting to
+    bench_out/telemetry.jsonl next to this file. Best-effort: a
+    journal failure must never fail the measurement itself."""
+    path = os.environ.get("BENCH_JOURNAL", "")
+    if path == "0":
+        return
+    if not path:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_out", "telemetry.jsonl")
+    try:
+        from commefficient_tpu.telemetry.journal import append_event
+        append_event(path, kind, digest=out)
+        log(f"digest journaled to {path}")
+    except (ImportError, OSError, TypeError, ValueError) as e:
+        log(f"digest journal append failed ({e}); continuing")
+
+
 def orchestrate() -> int:
     out = run_orchestrated("BENCH_SMALL")
     if out is None:
         out = {"metric": "cifar10_resnet9_sketch_round_time",
                "value": None, "unit": "ms/round", "vs_baseline": None,
                "error": "all bench children failed or timed out"}
+    journal_digest(out, "bench_digest")
     if out.get("platform") != "tpu":
         # the axon tunnel flaps for hours at a time; a degraded run
         # should still point the reader at the newest validated TPU

@@ -227,6 +227,9 @@ def orchestrate() -> int:
         if "PROF_CPU_TIMEOUT" in os.environ else None)
     if out is None:
         out = {"error": "all profile children failed or timed out"}
+    # same versioned JSONL record format as training-run journals
+    # (bench.journal_digest; BENCH_JOURNAL overrides/disables the path)
+    bench.journal_digest(out, "profile_digest")
     # compact single-line JSON: tpu_watch.sh's log_platform parses the
     # log line by line and cannot read an indented multi-line object
     print(json.dumps(out), flush=True)

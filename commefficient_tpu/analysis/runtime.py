@@ -51,6 +51,29 @@ _COMPILE_DURATION_EVENTS = frozenset({
 
 _counter = {"requests": 0, "backend": 0, "installed": False}
 
+# external compile subscribers (telemetry journal): called with
+# (event_name, duration_seconds) once per backend compile. Fed from the
+# DURATION listener only — it fires unconditionally per executable
+# build, while the cache-request event double-counts when both fire.
+_compile_subscribers: list = []
+
+
+def add_compile_listener(cb) -> None:
+    """Subscribe `cb(event_name, duration_s)` to backend-compile
+    events (the telemetry journal uses this to record every XLA
+    compile, and to flag steady-state recompiles). Idempotent per
+    callback object."""
+    _ensure_listener()
+    if cb not in _compile_subscribers:
+        _compile_subscribers.append(cb)
+
+
+def remove_compile_listener(cb) -> None:
+    try:
+        _compile_subscribers.remove(cb)
+    except ValueError:
+        pass
+
 
 def _on_event(event: str, **kw) -> None:
     if event in _COMPILE_EVENTS:
@@ -60,6 +83,8 @@ def _on_event(event: str, **kw) -> None:
 def _on_event_duration(event: str, duration: float, **kw) -> None:
     if event in _COMPILE_DURATION_EVENTS:
         _counter["backend"] += 1
+        for cb in list(_compile_subscribers):
+            cb(event, duration)
 
 
 def _ensure_listener() -> None:

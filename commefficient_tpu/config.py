@@ -71,6 +71,30 @@ class Config:
     # (fed_aggregator.py:46-52; SURVEY.md §5 tracing row)
     do_profile: bool = False
 
+    # observability (commefficient_tpu/telemetry, ISSUE 4). telemetry
+    # is ON by default: the jitted round computes a fixed-shape named
+    # f32 metric vector (telemetry/metrics.METRIC_NAMES — round loss,
+    # update/error norms, survivor count, processed examples, realized
+    # top-k, sketch estimate-residual proxy) that is exported to the
+    # host only at span boundaries via explicit device_get. Disabling
+    # it (--no_telemetry) traces the metric-free round program;
+    # ServerState bits are identical either way (tests/test_telemetry).
+    telemetry: bool = True
+    # journal file path ("" = <run dir>/journal.jsonl): the structured
+    # JSONL run record (telemetry/journal.py) — round/span metrics,
+    # checkpoint saves, XLA compile events, retries, injected faults
+    journal_path: str = ""
+    # capture a jax.profiler trace of scanned-span indices [A, B)
+    # ("" = off; requires --scan_rounds). Unlike --profile (whole first
+    # epoch), this targets operator-selected steady-state spans
+    profile_spans: str = ""
+    # arm analysis/runtime.forbid_transfers around the drivers'
+    # steady-state dispatch (every span/round after the first): any
+    # implicit host<->device transfer — a hidden per-round sync, the
+    # silent TPU performance cliff — raises instead of slowly burning
+    # the tunnel (ROADMAP PR-3 opening)
+    debug_transfer_guard: bool = False
+
     # compression (utils.py:142-147)
     k: int = 50000
     num_cols: int = 500000
@@ -362,6 +386,24 @@ class Config:
             raise ValueError(
                 "ckpt_every_spans must be >= 0 (0 = no span-boundary "
                 "saves, only the epoch cadence)")
+        if self.profile_spans:
+            # parse for side effect: a malformed spec fails at config
+            # time with the flag named, not mid-run
+            from commefficient_tpu.telemetry import parse_profile_spans
+            parse_profile_spans(self.profile_spans)
+            if not self.scan_rounds:
+                # spans only exist on the scanned path — without it the
+                # capture would silently never happen
+                raise ValueError(
+                    "--profile_spans requires --scan_rounds (span "
+                    "indices select SCANNED spans; use --profile for "
+                    "the per-round path's whole-first-epoch trace)")
+            if not self.telemetry:
+                # the capture is driven by the TelemetrySession that
+                # --no_telemetry skips constructing
+                raise ValueError(
+                    "--profile_spans requires telemetry (drop "
+                    "--no_telemetry: the session drives the capture)")
         if self.down_k < 0:
             raise ValueError("down_k must be >= 0 (0 = share the upload k)")
         if self.down_k > self.grad_size > 0:
@@ -395,6 +437,27 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--nan_threshold", type=float, default=999)
     p.add_argument("--profile", action="store_true", dest="do_profile",
                    help="jax.profiler trace of the first epoch")
+    p.add_argument("--no_telemetry", action="store_false",
+                   dest="telemetry",
+                   help="disable on-device round telemetry + the run "
+                        "journal (telemetry is ON by default and "
+                        "bit-neutral to training; see README "
+                        "'Observability')")
+    p.add_argument("--journal_path", type=str, default="",
+                   help="structured JSONL run-journal path (default: "
+                        "<run dir>/journal.jsonl; "
+                        "telemetry/journal.py)")
+    p.add_argument("--profile_spans", type=str, default="",
+                   help="with --scan_rounds: jax.profiler-capture "
+                        "scanned span indices [A, B), e.g. '2:4' "
+                        "(trace lands in <run dir>/profile_spans and "
+                        "the capture is journaled)")
+    p.add_argument("--debug_transfer_guard", action="store_true",
+                   help="arm jax.transfer_guard('disallow') around "
+                        "the steady-state training loop: any implicit "
+                        "host<->device transfer (a hidden per-round "
+                        "sync) raises instead of silently stalling "
+                        "rounds (analysis/runtime.forbid_transfers)")
 
     p.add_argument("--k", type=int, default=50000)
     p.add_argument("--num_cols", type=int, default=500000)

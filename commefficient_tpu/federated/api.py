@@ -32,6 +32,7 @@ the mask is the price of static shapes.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -46,6 +47,7 @@ from commefficient_tpu.federated.accounting import (
 from commefficient_tpu.ops.flat import flatten_params
 from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
+from commefficient_tpu.telemetry.clients import ClientThroughputTracker
 from commefficient_tpu.utils.faults import (
     FaultSchedule, InjectedFault, bernoulli_survivors,
     straggler_work_fractions,
@@ -171,6 +173,32 @@ class FedModel:
         # (utils/faults.FaultSchedule; set_fault_schedule)
         self._rounds_done = 0
         self.fault_schedule: Optional[FaultSchedule] = None
+        # observability (telemetry/): the throughput tracker always
+        # exists (cheap arrays; its state rides in every checkpoint so
+        # resume restores it even for runs that never journal), while
+        # the session — journal + profiler + the host-side metric
+        # conductor — is attached by the driver when cfg.telemetry is on
+        self.throughput = ClientThroughputTracker(self.num_clients)
+        self.telemetry = None
+
+    def attach_telemetry(self, session) -> None:
+        """Install a telemetry.TelemetrySession (or None to detach).
+        The model feeds it per-round device metric vectors on the
+        unscanned path (one-round lag — no added syncs) and whole
+        host-materialized spans from run_rounds; a session without its
+        own tracker is pointed at this model's `throughput`."""
+        self.telemetry = session
+        if session is not None and session.tracker is None:
+            session.tracker = self.throughput
+
+    def _journal_fault(self, kind: str, round_idx: int) -> None:
+        """Record an InjectedFault about to raise (utils/faults) in the
+        run journal — the crash boundary is then visible in the run's
+        own record, not just the process exit status."""
+        if self.telemetry is not None:
+            self.telemetry.journal_event("injected_fault", fault=kind,
+                                         round=int(round_idx))
+            self.telemetry.flush()
 
     def set_fault_schedule(self,
                            schedule: Optional[FaultSchedule]) -> None:
@@ -326,6 +354,10 @@ class FedModel:
                 *[place(f) for f in ckpt.clients])
         if ckpt.accountant_state:
             self.accountant.load_state_dict(ckpt.accountant_state)
+        if ckpt.throughput:
+            # per-client throughput EMA / participation — bit-exact
+            # resume (telemetry/clients.py; test_telemetry proves it)
+            self.throughput.load_state_dict(ckpt.throughput)
         if ckpt.prev_change_words is not None:
             self._prev_change_words = ckpt.prev_change_words
         # resync the host round mirror so dropout draws / crash points
@@ -375,13 +407,20 @@ class FedModel:
         if (self.fault_schedule is not None
                 and self.fault_schedule.should_crash_in_span(
                     this_round, 1)):
+            self._journal_fault("crash_in_span", this_round - 1)
             raise InjectedFault(this_round - 1)
         survivors, work = self._faults_for_round(this_round, client_ids)
 
         P = self._P
         lr = self._lr()
-        if isinstance(lr, np.ndarray):
-            lr = mh.globalize(self.mesh, P(), lr)
+        # explicit placement for BOTH lr shapes: a raw python float
+        # operand is an IMPLICIT host->device transfer at every
+        # dispatch — the first thing --debug_transfer_guard caught.
+        # np.float32(lr) is the identical f32 value the weak-typed
+        # scalar would have become, so results are bit-unchanged.
+        lr = mh.globalize(self.mesh, P(),
+                          lr if isinstance(lr, np.ndarray)
+                          else np.float32(lr))
         self.server, self.clients, metrics = self._train_round(
             self.server, self.clients,
             fround.RoundBatch(
@@ -412,11 +451,22 @@ class FedModel:
             survivors=survivors)
         self._prev_change_words = bits
 
+        # telemetry, one-round lag (same discipline as the metric
+        # return below): hand the session this round's DEVICE metric
+        # vector + example counts; it materializes the previous round's
+        # (already complete — free) and journals it
+        if self.telemetry is not None:
+            self.telemetry.on_round(
+                this_round, np.asarray(client_ids),
+                metrics.telemetry if self.cfg.telemetry else None,
+                metrics.num_examples)
+
         # injected preemption: the round above fully completed (state,
         # accounting, round counter) — crash at the exact boundary a
         # real preemption would leave behind
         if (self.fault_schedule is not None
                 and self.fault_schedule.should_crash(this_round)):
+            self._journal_fault("crash_after", this_round)
             raise InjectedFault(this_round)
 
         # metrics stay device arrays: callers that float() them decide
@@ -457,6 +507,7 @@ class FedModel:
         if (self.fault_schedule is not None
                 and self.fault_schedule.should_crash_in_span(
                     first, n_rounds)):
+            self._journal_fault("crash_in_span", first - 1)
             raise InjectedFault(first - 1)
 
         # span truncation at an injected crash boundary
@@ -520,8 +571,19 @@ class FedModel:
                     else mh.globalize(self.mesh, P(), work_all)),
                 mh.globalize(self.mesh, P(), lrs), self._key)
 
+        def _journal_retry(attempt: int, exc: BaseException,
+                           delay: float) -> None:
+            if self.telemetry is not None:
+                self.telemetry.journal_event(
+                    "retry", op="scanned round span",
+                    attempt=int(attempt), delay_s=round(delay, 3),
+                    error=repr(exc)[:200])
+
+        t_dispatch0 = time.monotonic()
         self.server, self.clients, metrics, bits = with_retries(
-            dispatch, describe="scanned round span")
+            dispatch, describe="scanned round span",
+            on_retry=_journal_retry)
+        t_dispatched = time.monotonic()
         self._rounds_done = first + n_rounds
 
         download = np.zeros(self.num_clients)
@@ -530,6 +592,21 @@ class FedModel:
         # transfer-guard-clean end to end — tests arm
         # analysis/runtime.forbid_transfers around the whole call
         bits_host = jax.device_get(bits)
+        t_blocked = time.monotonic()
+
+        # span-boundary telemetry export: ONE explicit device_get of
+        # the [N, M] metric rows + [N, W] example counts, after the
+        # bits transfer already forced span completion — telemetry adds
+        # no sync points, and the explicit gathers keep the span
+        # transfer-guard-clean (test_telemetry proves both)
+        if self.telemetry is not None:
+            tele_rows = (mh.gather_host(metrics.telemetry)
+                         if self.cfg.telemetry else None)
+            counts_rows = mh.gather_host(metrics.num_examples)
+            self.telemetry.on_span(
+                first, ids_host, tele_rows, counts_rows,
+                dispatch_s=t_dispatched - t_dispatch0,
+                block_s=t_blocked - t_dispatched)
         if self._prev_change_words is not None:
             # may still be a device array from a preceding single-round
             # call (the lazy-sync path in _call_train)
@@ -555,6 +632,7 @@ class FedModel:
         if crash_at is not None:
             # every completed round's state/accounting landed above —
             # crash at the same boundary the unscanned path does
+            self._journal_fault("crash_after", crash_at)
             raise InjectedFault(crash_at)
 
         losses = mh.gather_host(metrics.losses)

@@ -39,6 +39,7 @@ from commefficient_tpu.config import Config
 from commefficient_tpu.federated import client as fclient
 from commefficient_tpu.federated import server as fserver
 from commefficient_tpu.ops.flat import masked_topk
+from commefficient_tpu.telemetry import metrics as tmetrics
 
 
 class ServerState(NamedTuple):
@@ -93,9 +94,16 @@ class RoundBatch(NamedTuple):
 
 
 class RoundMetrics(NamedTuple):
+    """Per-round outputs that are NOT training state. `telemetry` is
+    the fixed-shape named f32 vector of telemetry/metrics.METRIC_NAMES
+    (zero-size when Config.telemetry is off, so the treedef per config
+    is stable) — pure observation computed from values the round
+    already produced; it feeds nothing back, so ServerState is
+    bit-identical with telemetry on or off."""
     losses: jax.Array            # [num_workers] per-client mean loss
     metrics: Tuple[jax.Array, ...]  # per-client means, each [num_workers]
     num_examples: jax.Array      # [num_workers]
+    telemetry: jax.Array = None  # [telemetry.metrics.NUM_METRICS] or [0]
 
 
 def init_server_state(cfg: Config, ps_weights: jax.Array,
@@ -497,7 +505,23 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             new_clients = new_clients._replace(
                 weights=new_clients.weights.at[ids].set(new_w))
 
-        return new_server, new_clients, RoundMetrics(losses, metrics, counts)
+        # on-device telemetry (telemetry/metrics.py): pure observation
+        # of values already computed — reads the applied delta and the
+        # NEW accumulator state, writes nothing back, so the state
+        # outputs above are bit-identical with cfg.telemetry off (the
+        # zero-size placeholder keeps the treedef stable per config)
+        if cfg.telemetry:
+            tele = tmetrics.round_vector(
+                losses=losses, counts=counts,
+                delta=new_ps - server.ps_weights,
+                verror=upd.Verror, vvelocity=upd.Vvelocity,
+                survivors=(jnp.float32(num_workers) if surv is None
+                           else surv.sum()))
+        else:
+            tele = tmetrics.empty_vector()
+
+        return new_server, new_clients, RoundMetrics(
+            losses, metrics, counts, tele)
 
     _train_round_jit = jax.jit(round_step)
 

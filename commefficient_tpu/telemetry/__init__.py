@@ -1,0 +1,321 @@
+"""commefficient_tpu.telemetry — the observability subsystem (ISSUE 4).
+
+Three parts, one session object tying them together:
+
+  * `metrics` — a fixed-shape NAMED f32 metric vector computed inside
+    the jitted round (round loss, update/error norms, survivor count,
+    processed examples, realized top-k, sketch estimate-residual
+    proxy), carried through scanned spans and exported to the host
+    only at span boundaries via explicit `device_get` — the
+    transfer-guard and three-programs contracts hold with telemetry
+    permanently on, and `ServerState` bits are provably unchanged;
+  * `journal` — an append-only JSONL event log in the run dir
+    recording round/span metrics, wall-clock spans, checkpoint saves,
+    XLA compile events, retry attempts, and injected faults; bench
+    harnesses append their digests in the same schema;
+  * `clients` — per-client EMA throughput + participation, persisted
+    in the checkpoint resume-bit-exact: the measurement substrate for
+    the ROADMAP's deadline-estimation and straggler-aware-sampling
+    openings.
+
+`TelemetrySession` is the host-side conductor FedModel dispatches into
+(`FedModel.attach_telemetry`): it buffers device metric vectors with a
+ONE-ROUND lag on the per-round path (materializing a round that has
+already completed costs no sync — the same discipline the drivers'
+metric emission uses, PERF.md), consumes whole spans at their natural
+boundary on the scanned path, feeds the throughput tracker, journals
+everything, and drives `jax.profiler` capture of operator-selected
+spans (`--profile_spans A:B`).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from commefficient_tpu.analysis import runtime as _runtime
+from commefficient_tpu.telemetry import metrics as tmetrics
+from commefficient_tpu.telemetry.clients import ClientThroughputTracker
+from commefficient_tpu.telemetry.journal import RunJournal, append_event
+
+__all__ = [
+    "ClientThroughputTracker", "RunJournal", "TelemetrySession",
+    "append_event", "attach_run_telemetry", "parse_profile_spans",
+    "tmetrics",
+]
+
+
+def parse_profile_spans(spec: str) -> Optional[Tuple[int, int]]:
+    """Parse `--profile_spans A:B` into a half-open span-index range
+    [A, B), or None for the empty spec. Raises ValueError on malformed
+    input (caught at config validation, not mid-run)."""
+    if not spec:
+        return None
+    lo, sep, hi = spec.partition(":")
+    try:
+        if not sep:
+            raise ValueError
+        a, b = int(lo), int(hi)
+    except ValueError:
+        raise ValueError(
+            f"--profile_spans expects 'A:B' (half-open span indices, "
+            f"e.g. '2:4'), got {spec!r}") from None
+    if a < 0 or b <= a:
+        raise ValueError(
+            f"--profile_spans {spec!r}: need 0 <= A < B")
+    return a, b
+
+
+def attach_run_telemetry(model, cfg, log_dir: str, coord: bool,
+                         driver: str,
+                         materialize: Callable = jax.device_get):
+    """Build + attach a run's TelemetrySession (both drivers share
+    this wiring): journal on the coordinator only (cfg.journal_path or
+    <run dir>/journal.jsonl), profiler capture per cfg.profile_spans,
+    the model's own throughput tracker, and the caller's device->host
+    materializer (multihost.gather_host in the drivers). Journals
+    `run_start` and returns the session — the caller owns close() —
+    or None under --no_telemetry."""
+    if not cfg.telemetry:
+        return None
+    journal = None
+    if coord:
+        jpath = cfg.journal_path or os.path.join(
+            log_dir or ".", "journal.jsonl")
+        journal = RunJournal(jpath, run_id=log_dir or driver)
+    tele = TelemetrySession(
+        journal=journal, tracker=model.throughput,
+        profile_spans=cfg.profile_spans,
+        profile_dir=os.path.join(log_dir or ".", "profile_spans"),
+        materialize=materialize)
+    model.attach_telemetry(tele)
+    tele.journal_event(
+        "run_start", driver=driver, mode=cfg.mode,
+        dataset=cfg.dataset_name, num_workers=cfg.num_workers,
+        num_clients=model.num_clients, grad_size=model.cfg.grad_size,
+        scan_rounds=bool(cfg.scan_rounds),
+        transfer_guard=bool(cfg.debug_transfer_guard),
+        resumed_round=int(np.asarray(
+            materialize(model.server.round_idx))))
+    return tele
+
+
+class TelemetrySession:
+    """Host-side telemetry conductor for one run.
+
+    journal:       RunJournal or None (non-coordinator processes pass
+                   None — tracker updates still run, since every
+                   process gathers identical metrics)
+    tracker:       ClientThroughputTracker or None; FedModel.
+                   attach_telemetry fills in the model's own tracker
+                   when unset
+    profile_spans: `--profile_spans` spec ("" = no capture)
+    profile_dir:   where jax.profiler traces land
+    materialize:   device->host function for buffered metric arrays;
+                   pass multihost.gather_host in multi-controller runs
+                   (the default jax.device_get only handles
+                   process-addressable arrays)
+    """
+
+    def __init__(self, journal: Optional[RunJournal] = None,
+                 tracker: Optional[ClientThroughputTracker] = None,
+                 profile_spans: str = "",
+                 profile_dir: str = "profile_spans",
+                 materialize: Callable = jax.device_get,
+                 clock: Callable[[], float] = time.monotonic):
+        self.journal = journal
+        self.tracker = tracker
+        self._materialize = materialize
+        self._clock = clock
+        self._spans = parse_profile_spans(profile_spans)
+        self._profile_dir = profile_dir
+        self._profiling = False
+        self._steady = False
+        # per-round path: (round_idx, ids, vec, counts, t) buffer — the
+        # previous round materializes when the next one arrives (its
+        # device values are complete by then; device_get costs no sync)
+        self._pending = None
+        self._closed = False
+        self._journal_warned = False
+        _runtime.add_compile_listener(self._on_compile)
+
+    # ---------------- journal passthrough --------------------------------
+    def _safe_write(self, write: Callable[[], object]) -> None:
+        """Observability must never kill training: a journal append
+        that fails (disk full, unwritable path mid-run) warns once and
+        the run continues — the same contract bench.journal_digest
+        keeps for measurements. Notably the retry hook journals from
+        INSIDE utils/retry.with_retries; an exception there would turn
+        a recoverable transient into a fatal span failure."""
+        try:
+            write()
+        except (OSError, TypeError, ValueError) as e:
+            # TypeError included: a field json can't serialize must
+            # degrade to a lost record, not a crashed run
+            if not self._journal_warned:
+                print(f"telemetry: journal write failed ({e}); "
+                      f"training continues, further failures silent")
+                self._journal_warned = True
+
+    def journal_event(self, kind: str, **fields) -> None:
+        if self.journal is not None:
+            self._safe_write(lambda: self.journal.event(kind, **fields))
+
+    # ---------------- compile events (analysis/runtime listener) ---------
+    def mark_steady_state(self) -> None:
+        """After this, every backend compile is journaled as a
+        `compile_warning` — steady-state recompiles are retrace bugs
+        (new treedef/shape/weak-type leak), the regression class
+        assert_program_count(3) pins in tests and this surfaces in
+        production journals. The drivers call it once the first full
+        epoch (train spans + eval) has compiled everything a
+        steady-state run legitimately needs."""
+        self._steady = True
+
+    @contextlib.contextmanager
+    def expect_compiles(self, why: str = ""):
+        """Temporarily allow compiles without warnings (e.g. a final
+        eval program that legitimately first-compiles long after the
+        training loop reached steady state)."""
+        prev, self._steady = self._steady, False
+        try:
+            yield
+        finally:
+            self._steady = prev
+
+    def _on_compile(self, event_name: str, duration: float) -> None:
+        if self.journal is None:
+            return
+        fields = {"event_name": event_name}
+        if duration is not None:
+            fields["seconds"] = round(float(duration), 4)
+        if self._steady:
+            self.journal_event(
+                "compile_warning", unexpected=True,
+                why="backend compile after steady state: an accidental "
+                    "retrace (see analysis/runtime.py)", **fields)
+        else:
+            self.journal_event("compile", **fields)
+
+    # ---------------- per-round path (FedModel.__call__) -----------------
+    def on_round(self, round_idx: int, client_ids, telemetry_vec,
+                 num_examples) -> None:
+        """Buffer one round's device metrics; materialize + journal the
+        PREVIOUS round (one-round lag, so no per-round host sync)."""
+        now = self._clock()
+        prev, self._pending = self._pending, (
+            int(round_idx), np.asarray(client_ids), telemetry_vec,
+            num_examples, now)
+        if prev is not None:
+            self._emit_round(prev, now - prev[4])
+
+    def _emit_round(self, rec, seconds: Optional[float]) -> None:
+        round_idx, ids, vec, counts, _ = rec
+        counts_h = np.asarray(self._materialize(counts))
+        if (self.tracker is not None and seconds is not None
+                and seconds > 0):
+            self.tracker.update_round(ids, counts_h, seconds)
+        if self.journal is not None:
+            fields = {"round": round_idx}
+            named = tmetrics.named(
+                None if vec is None else np.asarray(
+                    self._materialize(vec), np.float32))
+            if named:
+                fields["metrics"] = named
+            if seconds is not None:
+                fields["seconds"] = round(seconds, 6)
+            self.journal_event("round", **fields)
+
+    def flush(self) -> None:
+        """Drain the one-round-lag buffer (end of epoch/run; before a
+        deliberate crash boundary). The drained round has no interval
+        measurement, so it journals without `seconds` and skips the
+        tracker."""
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._emit_round(prev, None)
+
+    # ---------------- span path (FedModel.run_rounds) --------------------
+    def on_span(self, first_round: int, ids_rows: np.ndarray,
+                telemetry_rows: Optional[np.ndarray],
+                counts_rows: np.ndarray,
+                dispatch_s: float, block_s: float) -> None:
+        """Consume one completed scanned span: host-materialized
+        [N, W] ids/counts and [N, M] metric rows (the caller did the
+        explicit span-boundary device_get). Journals one `span` event
+        plus one `round` event per round, and feeds the tracker with
+        the span-amortized per-round wall time."""
+        # a pending per-round buffer holds an EARLIER round (mixed
+        # per-round + scanned usage): drain it first so the journal's
+        # round events stay strictly ordered
+        self.flush()
+        n = int(np.asarray(ids_rows).shape[0])
+        per_round_s = (dispatch_s + block_s) / max(n, 1)
+        if self.tracker is not None:
+            for i in range(n):
+                self.tracker.update_round(
+                    ids_rows[i], counts_rows[i], per_round_s)
+        if self.journal is not None:
+            batch = [("span", {"first_round": int(first_round),
+                               "rounds": n,
+                               "dispatch_s": round(dispatch_s, 6),
+                               "block_s": round(block_s, 6)})]
+            for i in range(n):
+                fields = {"round": int(first_round) + i,
+                          "seconds": round(per_round_s, 6)}
+                if telemetry_rows is not None:
+                    named = tmetrics.named(
+                        np.asarray(telemetry_rows[i], np.float32))
+                    if named:
+                        fields["metrics"] = named
+                batch.append(("round", fields))
+            # one append + fsync for the whole span's records
+            self._safe_write(lambda: self.journal.events(batch))
+
+    # ---------------- profiler capture (--profile_spans) -----------------
+    def span_profile_begin(self, span_idx: int) -> None:
+        """Start a jax.profiler trace when `span_idx` enters the
+        requested [A, B) window (called by scanloop before each span's
+        dispatch). One contiguous capture covers the whole window."""
+        if (self._spans is None or self._profiling
+                or not (self._spans[0] <= span_idx < self._spans[1])):
+            return
+        os.makedirs(self._profile_dir, exist_ok=True)
+        jax.profiler.start_trace(self._profile_dir)
+        self._profiling = True
+        self.journal_event("profile_start", span=span_idx,
+                           dir=self._profile_dir)
+
+    def span_profile_end(self, span_idx: int) -> None:
+        """Stop the capture once the window's last span completed (the
+        caller's run_rounds already forced device completion, so the
+        trace covers the span's real device work)."""
+        if not self._profiling or span_idx < self._spans[1] - 1:
+            return
+        jax.profiler.stop_trace()
+        self._profiling = False
+        self.journal_event("profile_stop", span=span_idx,
+                           dir=self._profile_dir)
+
+    # ---------------- lifecycle ------------------------------------------
+    def close(self, **fields) -> None:
+        """Drain buffers, stop a live profiler capture, detach the
+        compile listener, and journal `run_end` with `fields`."""
+        if self._closed:
+            return
+        self._closed = True
+        self.flush()
+        if self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self.journal_event("profile_stop", span=-1,
+                               dir=self._profile_dir)
+        _runtime.remove_compile_listener(self._on_compile)
+        if self.journal is not None:
+            self.journal_event("run_end", **fields)
+            self.journal.close()

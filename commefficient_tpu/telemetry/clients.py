@@ -1,0 +1,145 @@
+"""Per-client throughput tracking: EMA examples/sec + participation.
+
+The ROADMAP's two named scheduling openings — deadline estimation from
+MEASURED per-client throughput (instead of the scripted/static work
+fractions of utils/faults) and straggler-aware client sampling
+(deprioritize chronically slow clients) — both need one substrate: a
+per-client record of how fast each client actually processes examples,
+fed from real round timings and surviving checkpoint/resume. This
+module is that substrate.
+
+Feeding: TelemetrySession hands the tracker one
+(client_ids, examples_processed, round_seconds) triple per round, from
+span-boundary metrics (scanned path: span wall time amortized over its
+rounds) or inter-dispatch intervals (per-round path — a steady-state
+approximation, since dispatch is async the interval converges to the
+true round time once the device is the bottleneck). Dropped clients
+arrive with zero examples: their participation is counted but their
+EMA is untouched (a dead round says nothing about their speed).
+
+Persistence: `state_dict`/`load_state_dict` round-trip plain numpy
+arrays bit-exactly; utils/checkpoint embeds them under `thr_*` keys
+(next to the fingerprint, so a resume into a different client
+population fails loudly) and FedModel.load_state restores them —
+crash->resume preserves every EMA bit-exactly
+(tests/test_telemetry.py).
+
+Determinism caveat: the RATES are wall-clock derived, so two runs of
+the same seed produce different rates — the tracker informs
+SCHEDULING, never the model update, keeping the round engine's
+pure-(state, seed, round) contract intact.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+# state_dict keys, fixed order (checkpoint serialization contract)
+STATE_KEYS = ("rate", "participations", "completions", "busy_seconds")
+
+
+class ClientThroughputTracker:
+    """EMA examples/sec and participation accounting per client.
+
+    rate[c]           EMA of client c's examples/sec over its COMPLETED
+                      rounds (0.0 until the first completion — callers
+                      must treat 0 as "unmeasured", see
+                      estimate_round_seconds)
+    participations[c] rounds client c was sampled into
+    completions[c]    rounds client c actually processed examples in
+    busy_seconds[c]   cumulative wall seconds of rounds c completed
+    """
+
+    def __init__(self, num_clients: int, ema_decay: float = 0.9):
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay={ema_decay} must be in (0, 1)")
+        self.num_clients = int(num_clients)
+        self.ema_decay = float(ema_decay)
+        self.rate = np.zeros(self.num_clients, np.float32)
+        self.participations = np.zeros(self.num_clients, np.int64)
+        self.completions = np.zeros(self.num_clients, np.int64)
+        self.busy_seconds = np.zeros(self.num_clients, np.float64)
+
+    def update_round(self, client_ids, num_examples, round_seconds,
+                     survivors: Optional[np.ndarray] = None) -> None:
+        """Fold one round's measurements in.
+
+        client_ids:    [W] global ids sampled into the round (assumed
+                       distinct — the sampler draws without
+                       replacement; duplicate ids would collapse to one
+                       fancy-index write)
+        num_examples:  [W] examples each slot actually processed (the
+                       round engine already zeroes dropped clients and
+                       truncates stragglers)
+        round_seconds: wall-clock seconds this round took; <= 0 or None
+                       skips the update (no timing signal)
+        survivors:     optional [W] mask; zeroes num_examples for
+                       callers whose counts don't already encode drops
+        """
+        if round_seconds is None or not round_seconds > 0:
+            return
+        ids = np.asarray(client_ids, np.int64).reshape(-1)
+        ex = np.asarray(num_examples, np.float64).reshape(-1)
+        if survivors is not None:
+            ex = ex * (np.asarray(survivors).reshape(-1) > 0)
+        self.participations[ids] += 1
+        done = ex > 0
+        done_ids = ids[done]
+        self.completions[done_ids] += 1
+        self.busy_seconds[done_ids] += float(round_seconds)
+        if not done.any():
+            return
+        sample = (ex[done] / float(round_seconds)).astype(np.float32)
+        prev = self.rate[done_ids]
+        d = np.float32(self.ema_decay)
+        # first completion seeds the EMA with the sample itself (an
+        # EMA warmed from 0 would need ~1/(1-decay) rounds to stop
+        # underestimating every client)
+        first = self.completions[done_ids] <= 1
+        self.rate[done_ids] = np.where(
+            first, sample, d * prev + (np.float32(1.0) - d) * sample)
+
+    # -- consumers (deadline estimation / straggler-aware sampling) -------
+    def examples_per_sec(self, client_ids=None) -> np.ndarray:
+        """Current EMA rates (a copy); 0.0 marks unmeasured clients."""
+        if client_ids is None:
+            return self.rate.copy()
+        return self.rate[np.asarray(client_ids, np.int64)].copy()
+
+    def estimate_round_seconds(self, client_ids,
+                               num_examples) -> np.ndarray:
+        """Expected seconds for each client to process its batch at its
+        measured EMA rate — the deadline-estimation primitive. Clients
+        with no completed round yet estimate to +inf so callers fall
+        back to a prior instead of treating them as infinitely fast."""
+        ids = np.asarray(client_ids, np.int64)
+        ex = np.asarray(num_examples, np.float64)
+        r = self.rate[ids].astype(np.float64)
+        with np.errstate(divide="ignore"):
+            return np.where(r > 0, ex / np.maximum(r, 1e-30), np.inf)
+
+    # -- checkpoint round-trip (bit-exact) --------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "rate": self.rate.copy(),
+            "participations": self.participations.copy(),
+            "completions": self.completions.copy(),
+            "busy_seconds": self.busy_seconds.copy(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        rate = np.asarray(state["rate"], np.float32)
+        if rate.shape[0] != self.num_clients:
+            raise ValueError(
+                f"throughput state tracks {rate.shape[0]} clients; "
+                f"this run has {self.num_clients} — the checkpoint "
+                f"fingerprint should have rejected this resume")
+        self.rate = rate.copy()
+        self.participations = np.asarray(
+            state["participations"], np.int64).copy()
+        self.completions = np.asarray(
+            state["completions"], np.int64).copy()
+        self.busy_seconds = np.asarray(
+            state["busy_seconds"], np.float64).copy()

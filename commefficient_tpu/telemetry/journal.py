@@ -1,0 +1,278 @@
+"""Structured run journal: an append-only JSONL event log in the run
+dir.
+
+Every record is one JSON object per line with three mandatory fields —
+`v` (schema version), `event` (record kind), `ts` (wall-clock epoch
+seconds) — plus kind-specific payload. One schema serves every
+producer: training runs (round/span metrics, checkpoint saves, XLA
+compile events, retry attempts, injected faults), bench harnesses
+(bench.py / benchmarks/profile_round.py append their digests as
+`bench_digest` / `profile_digest` events), and future tooling, so a
+perf investigation reads ONE record format instead of correlating
+stdout tables with BENCH_*.json by hand.
+
+Durability: appends route through utils/atomic_io.atomic_append_line
+(flush + fsync per record); a preemption can tear at most the final
+line, which `read_journal`/`validate_journal` detect and report
+without losing committed records. Only the coordinator of a
+multi-controller run writes (drivers construct the journal behind
+`mh.is_coordinator()`).
+
+Known event kinds written by the framework (all optional-fielded;
+consumers must tolerate kinds they don't know):
+
+  run_start / run_end     driver lifecycle, config snapshot / ok flag
+  round                   one federated round: `round` index, optional
+                          `metrics` dict named per telemetry.metrics.
+                          METRIC_NAMES, optional `seconds`
+  span                    one scanned span: first_round, rounds,
+                          dispatch_s (host staging + dispatch),
+                          block_s (device completion wait)
+  epoch                   driver epoch summary row
+  checkpoint              one rotated save: path, seconds
+  compile / compile_warning   XLA backend compile (via the
+                          analysis/runtime listener); the _warning
+                          variant marks a compile AFTER steady state —
+                          an unexpected retrace
+  retry                   one utils/retry backoff attempt
+  injected_fault          a utils/faults InjectedFault about to raise
+  profile_start / profile_stop   jax.profiler capture of operator-
+                          selected spans (--profile_spans)
+  bench_digest / profile_digest  bench harness result records
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from commefficient_tpu.utils.atomic_io import atomic_append_lines
+
+SCHEMA_VERSION = 1
+
+# fields every record must carry to be schema-valid
+REQUIRED_FIELDS = ("v", "event", "ts")
+
+
+def _jsonable(obj):
+    """json.dumps default hook: numpy scalars/arrays -> python."""
+    if isinstance(obj, np.bool_):
+        return bool(obj)
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError(f"not JSON-serializable: {type(obj).__name__}")
+
+
+# strict-JSON sentinels for non-finite floats (see _finite)
+NONFINITE = {"nan": "NaN", "inf": "Infinity", "-inf": "-Infinity"}
+
+
+def _finite(obj):
+    """Replace non-finite floats with their string sentinels ("NaN",
+    "Infinity", "-Infinity"), recursively. Python's json module would
+    happily emit bare `NaN` tokens (allow_nan defaults True) — lines
+    no strict JSONL consumer (jq, Go/Rust/JS parsers) accepts; a
+    diverging run's train_loss is exactly when the journal matters
+    most, so the value is preserved as a recoverable string instead of
+    dropped or left spec-invalid."""
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return NONFINITE[repr(obj)]
+    if isinstance(obj, np.floating) and not np.isfinite(obj):
+        return NONFINITE[repr(float(obj))]
+    if isinstance(obj, dict):
+        return {k: _finite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finite(v) for v in obj]
+    return obj
+
+
+class RunJournal:
+    """Append-only JSONL writer for one run.
+
+    Construction creates the parent directory but writes nothing; the
+    first `event()` call creates the file. The object is stateless
+    beyond its path — safe to reconstruct (e.g. `append_event`) and to
+    leave unclosed; every record is durable as soon as `event`
+    returns."""
+
+    def __init__(self, path: str, run_id: str = "",
+                 clock: Callable[[], float] = time.time):
+        self.path = path
+        self.run_id = run_id
+        self._clock = clock
+        # a torn tail can only predate this writer's first append —
+        # seal-check once, then skip the per-record read
+        self._tail_checked = False
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def _record(self, kind: str, fields: dict) -> dict:
+        rec = {"v": SCHEMA_VERSION, "event": str(kind),
+               "ts": round(float(self._clock()), 6)}
+        if self.run_id:
+            rec["run_id"] = self.run_id
+        rec.update(fields)
+        return rec
+
+    def event(self, kind: str, **fields) -> dict:
+        """Append one record; returns the dict that was written."""
+        rec = self._record(kind, fields)
+        atomic_append_lines(
+            self.path, (json.dumps(_finite(rec), default=_jsonable),),
+            check_tail=not self._tail_checked)
+        self._tail_checked = True
+        return rec
+
+    def events(self, batch) -> List[dict]:
+        """Append many records — `batch` is (kind, fields) pairs — with
+        ONE flush+fsync for the lot. The span-boundary path uses this:
+        a span's N round records are produced at the same instant, so
+        per-record fsyncs would buy no durability, only a host stall
+        proportional to span length."""
+        recs = [self._record(kind, fields) for kind, fields in batch]
+        atomic_append_lines(
+            self.path,
+            [json.dumps(_finite(r), default=_jsonable) for r in recs],
+            check_tail=not self._tail_checked)
+        self._tail_checked = True
+        return recs
+
+    def close(self) -> None:
+        """No buffered state to flush (every event is already durable);
+        kept so callers can treat the journal like a file handle."""
+
+
+def append_event(path: str, kind: str, **fields) -> dict:
+    """One-shot append for producers without a long-lived journal
+    (bench harness digests)."""
+    return RunJournal(path).event(kind, **fields)
+
+
+# ---------------- reading + invariant validation -------------------------
+
+def read_journal(path: str) -> Tuple[List[dict], List[str]]:
+    """Parse a journal file. Returns (records, problems): records are
+    the successfully parsed lines in order; problems are human-readable
+    descriptions of malformed lines. A torn FINAL line (the one shape a
+    preemption mid-append can produce) is reported as a problem but
+    does not invalidate the committed records before it."""
+    records: List[dict] = []
+    problems: List[str] = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            problems.append(f"line {i}: blank line")
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            tag = " (torn tail?)" if i == len(lines) else ""
+            problems.append(f"line {i}: not valid JSON{tag}")
+            continue
+        if not isinstance(rec, dict):
+            problems.append(f"line {i}: not a JSON object")
+            continue
+        records.append(rec)
+    return records, problems
+
+
+def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
+    """Journal invariants as a checkable function (shared by
+    scripts/journal_summary.py and tests/test_telemetry.py):
+
+      * every line parses as a JSON object carrying v/event/ts;
+      * `v` matches SCHEMA_VERSION;
+      * `round` events carry an integer `round` and never repeat a
+        round index WITHIN one run segment (a duplicate means two code
+        paths journaled the same round);
+      * `round` indices are strictly increasing within a segment;
+      * `metrics` payloads (when present) are {str: number} dicts.
+
+    A `run_start` event opens a new run SEGMENT and resets the round
+    tracking: a preempted run resumed with the same --journal_path
+    legitimately replays rounds journaled after its last checkpoint
+    (its run_start carries `resumed_round`), so cross-segment repeats
+    are healthy history, not violations.
+
+    Returns (records, problems); an empty problems list means the
+    journal is valid."""
+    records, problems = read_journal(path)
+    seen_rounds = set()
+    last_round = None
+    for n, rec in enumerate(records, 1):
+        if rec.get("event") == "run_start":
+            seen_rounds = set()
+            last_round = None
+        for field in REQUIRED_FIELDS:
+            if field not in rec:
+                problems.append(f"record {n}: missing `{field}`")
+        v = rec.get("v")
+        if v is not None and v != SCHEMA_VERSION:
+            problems.append(
+                f"record {n}: schema version {v!r} != {SCHEMA_VERSION}")
+        if not isinstance(rec.get("ts", 0.0), (int, float)):
+            problems.append(f"record {n}: non-numeric `ts`")
+        if rec.get("event") == "round":
+            r = rec.get("round")
+            if not isinstance(r, int):
+                problems.append(f"record {n}: round event without an "
+                                f"integer `round` (got {r!r})")
+                continue
+            if r in seen_rounds:
+                problems.append(f"record {n}: duplicate round {r}")
+            elif last_round is not None and r <= last_round:
+                problems.append(
+                    f"record {n}: round {r} out of order "
+                    f"(after round {last_round})")
+            seen_rounds.add(r)
+            last_round = r if last_round is None else max(last_round, r)
+            m = rec.get("metrics")
+            if m is not None:
+                if not isinstance(m, dict):
+                    problems.append(
+                        f"record {n}: `metrics` is not an object")
+                else:
+                    # the non-finite sentinels (_finite) are legal —
+                    # a diverging run's NaN loss is valid telemetry
+                    ok_strings = set(NONFINITE.values())
+                    bad = [k for k, val in m.items()
+                           if not (isinstance(val, (int, float))
+                                   or val in ok_strings)]
+                    if bad:
+                        problems.append(
+                            f"record {n}: non-numeric metrics {bad}")
+    return records, problems
+
+
+def summarize(records: List[dict]) -> dict:
+    """Small host-side digest of a journal: event-kind counts, round
+    coverage, total journaled wall time in spans/checkpoints."""
+    kinds: dict = {}
+    rounds = []
+    span_s = ckpt_s = 0.0
+    for rec in records:
+        kind = rec.get("event", "?")
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "round" and isinstance(rec.get("round"), int):
+            rounds.append(rec["round"])
+        elif kind == "span":
+            span_s += float(rec.get("dispatch_s", 0.0))
+            span_s += float(rec.get("block_s", 0.0))
+        elif kind == "checkpoint":
+            ckpt_s += float(rec.get("seconds", 0.0))
+    return {
+        "records": len(records),
+        "events": dict(sorted(kinds.items())),
+        "rounds": len(rounds),
+        "first_round": min(rounds) if rounds else None,
+        "last_round": max(rounds) if rounds else None,
+        "span_seconds": round(span_s, 3),
+        "checkpoint_seconds": round(ckpt_s, 3),
+    }

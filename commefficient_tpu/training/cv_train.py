@@ -17,6 +17,7 @@ CIFAR10 --mode sketch --error_type virtual ...
 """
 from __future__ import annotations
 
+import contextlib
 import math
 import os
 from typing import Optional
@@ -136,6 +137,17 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
           train_loader, val_loader, cfg: Config,
           loggers=(), timer: Optional[Timer] = None, log_dir: str = ""):
     timer = timer or Timer()
+    # --debug_transfer_guard: forbid implicit host<->device transfers
+    # in the steady-state loop — every span/round after the first
+    # (which compiles) dispatches under the guard, so a hidden
+    # per-round sync raises instead of silently stalling the tunnel
+    guard = None
+    if cfg.debug_transfer_guard:
+        from commefficient_tpu.analysis.runtime import forbid_transfers
+        guard = forbid_transfers
+    # first dispatch of THIS PROCESS compiles (also after a resume, so
+    # this is a process-local flag, not round count)
+    warmed = [False]
     spe = train_loader.steps_per_epoch
     total_rounds = math.ceil(cfg.num_epochs * spe)
     # on resume, num_epochs is the TOTAL budget: rounds already done
@@ -226,7 +238,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 # span-boundary saves bound a mid-span preemption's
                 # loss to ckpt_every_spans spans, not one epoch
                 checkpoint=make_span_checkpoint(
-                    _ckpt_path(cfg), model, cfg, lr_scheduler))
+                    _ckpt_path(cfg), model, cfg, lr_scheduler),
+                guard=guard)
             rounds_done += taken
         else:
             # metrics materialize with a ONE-ROUND lag: float()ing the
@@ -250,7 +263,15 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 if rounds_done >= total_rounds:
                     break
                 lr_scheduler.step()
-                loss, acc, d, u = model((client_ids, data, mask))
+                # first dispatch of the process compiles; every later
+                # one is steady state and runs under the (optional)
+                # transfer guard — same warmup exemption as the
+                # scanned path
+                ctx = (guard() if guard is not None and warmed[0]
+                       else contextlib.nullcontext())
+                with ctx:
+                    loss, acc, d, u = model((client_ids, data, mask))
+                warmed[0] = True
                 opt.step()
                 down += d
                 up += u
@@ -303,11 +324,24 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             for name, value in row.items():
                 if name != "epoch":
                     writer.add_scalar(name.split(" ")[0], value, epoch)
+        if model.telemetry is not None:
+            # drain the one-round-lag metric buffer, then journal the
+            # same summary row the stdout table shows
+            model.telemetry.flush()
+            model.telemetry.journal_event(
+                "epoch", **{k.replace(" (MiB)", "_mib"): v
+                            for k, v in row.items()})
+            # one full epoch compiled everything a steady-state run
+            # needs (train round + eval); later compiles are retraces
+            # and journal as compile_warning
+            model.telemetry.mark_steady_state()
 
         if cfg.checkpoint_every and epoch % cfg.checkpoint_every == 0:
             # atomic rotated save: keep-last-k round-stamped files + a
             # `latest` manifest, so a preemption at ANY instant leaves
             # a loadable checkpoint for --resume (utils/checkpoint)
+            import time
+            t0 = time.monotonic()  # monotonic like the sibling sites
             path = save_rotating(
                 _ckpt_path(cfg), model.server, model.clients,
                 keep_last=cfg.keep_checkpoints,
@@ -315,7 +349,12 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 scheduler_step=lr_scheduler.step_count,
                 accountant=model.accountant,
                 prev_change_words=model._prev_change_words,
-                fingerprint=model.checkpoint_fingerprint)
+                fingerprint=model.checkpoint_fingerprint,
+                throughput=model.throughput.state_dict())
+            if model.telemetry is not None:
+                model.telemetry.journal_event(
+                    "checkpoint", path=path,
+                    seconds=round(time.monotonic() - t0, 3))
             if mh.is_coordinator():
                 print(f"checkpointed to {path}")
 
@@ -456,28 +495,45 @@ def main(argv=None) -> bool:
     coord = mh.is_coordinator()
     # only the coordinator creates a run dir
     log_dir = make_logdir(cfg) if coord else ""
+    from commefficient_tpu.telemetry import attach_run_telemetry
+    tele = attach_run_telemetry(model, cfg, log_dir, coord,
+                                driver="cv_train",
+                                materialize=mh.gather_host)
     if coord:
         print(f"Finished initializing in {timer():.2f} seconds")
 
-    ok = train(model, opt, lr_scheduler, train_loader, val_loader, cfg,
-               loggers=(TableLogger(),) if coord else (), timer=timer,
-               log_dir=log_dir)
-    model.finalize()
+    ok = False
+    try:
+        ok = train(model, opt, lr_scheduler, train_loader, val_loader,
+                   cfg, loggers=(TableLogger(),) if coord else (),
+                   timer=timer, log_dir=log_dir)
+        model.finalize()
 
-    if cfg.do_checkpoint:
-        # collective (gathers sharded client state); coordinator
-        # writes stamped + manifest (what --resume prefers) AND the
-        # fixed-name artifact the finetune path loads, in one gather
-        path = save_final(_ckpt_path(cfg), model.server, model.clients,
-                          keep_last=cfg.keep_checkpoints,
-                          max_age_hours=cfg.ckpt_max_age_hours,
-                          scheduler_step=lr_scheduler.step_count,
-                          accountant=model.accountant,
-                          prev_change_words=model._prev_change_words,
-                          fingerprint=model.checkpoint_fingerprint)
-        if coord:
-            print(f"saved checkpoint to {path}")
+        if cfg.do_checkpoint:
+            # collective (gathers sharded client state); coordinator
+            # writes stamped + manifest (what --resume prefers) AND the
+            # fixed-name artifact the finetune path loads, in one gather
+            path = save_final(
+                _ckpt_path(cfg), model.server, model.clients,
+                keep_last=cfg.keep_checkpoints,
+                max_age_hours=cfg.ckpt_max_age_hours,
+                scheduler_step=lr_scheduler.step_count,
+                accountant=model.accountant,
+                prev_change_words=model._prev_change_words,
+                fingerprint=model.checkpoint_fingerprint,
+                throughput=model.throughput.state_dict())
+            if coord:
+                print(f"saved checkpoint to {path}")
+    finally:
+        # close even when training raises (an InjectedFault drill, a
+        # NaN abort, a real crash): the session must detach its global
+        # compile listener and stop any live profiler capture, or the
+        # next in-process run inherits both
+        if tele is not None:
+            tele.close(ok=bool(ok))
     return ok
+
+
 
 
 def _mask_to_lr_scales(params, frozen_mask) -> np.ndarray:

@@ -15,8 +15,10 @@ PERSONA --mode sketch --error_type virtual ...
 """
 from __future__ import annotations
 
+import contextlib
 import math
 import os
+import time
 from typing import Optional
 
 import jax
@@ -156,6 +158,14 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
     logger = logger or TableLogger()
     spe = train_loader.steps_per_epoch
     epoch_download = epoch_upload = 0.0
+    # --debug_transfer_guard: implicit host<->device transfers raise in
+    # the steady-state loop (every dispatch after the compiling first
+    # one) — same wiring as cv_train.train
+    guard = None
+    if cfg.debug_transfer_guard:
+        from commefficient_tpu.analysis.runtime import forbid_transfers
+        guard = forbid_transfers
+    warmed = [False]
     # on resume, num_epochs is the TOTAL budget: rounds already done
     # (restored round_idx) count against it — same contract as
     # cv_train.train (cv_train.py:136-140); without this the resumed
@@ -237,13 +247,19 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 # span-boundary saves bound a mid-span preemption's
                 # loss to ckpt_every_spans spans, not one epoch
                 checkpoint=make_span_checkpoint(
-                    ckpt_path, model, cfg, lr_scheduler))
+                    ckpt_path, model, cfg, lr_scheduler),
+                guard=guard)
         else:
             for client_ids, data, mask in epoch_stream:
                 if batch_idx - epoch * spe >= spe * frac:
                     break
                 lr_scheduler.step()
-                loss, lm, mc, down, up = model((client_ids, data, mask))
+                ctx = (guard() if guard is not None and warmed[0]
+                       else contextlib.nullcontext())
+                with ctx:
+                    loss, lm, mc, down, up = model(
+                        (client_ids, data, mask))
+                warmed[0] = True
                 opt.step()
                 batch_idx += 1
                 if epoch == 0:
@@ -272,9 +288,22 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
         # mid-run checkpoint so --resume has something to pick up when
         # the run is killed (symmetric with cv_train.py's per-epoch
         # save; the resume-read half alone would be unreachable)
+        if model.telemetry is not None:
+            # drain the one-round-lag metric buffer + journal an epoch
+            # summary (symmetric with cv_train.train); after one full
+            # epoch the train programs are compiled — later train-loop
+            # compiles journal as compile_warning (the final eval runs
+            # under expect_compiles, see main)
+            model.telemetry.flush()
+            model.telemetry.journal_event(
+                "epoch", epoch=epoch,
+                train_loss=(losses[-1] if losses else None),
+                rounds=batch_idx)
+            model.telemetry.mark_steady_state()
         if cfg.checkpoint_every and epoch % cfg.checkpoint_every == 0:
             # atomic rotated save (keep-last-k + `latest` manifest) —
             # the preemption-safe half of --resume (utils/checkpoint)
+            t0 = time.monotonic()
             written = save_rotating(
                 ckpt_path, model.server, model.clients,
                 keep_last=cfg.keep_checkpoints,
@@ -282,7 +311,12 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 scheduler_step=lr_scheduler.step_count,
                 accountant=model.accountant,
                 prev_change_words=model._prev_change_words,
-                fingerprint=model.checkpoint_fingerprint)
+                fingerprint=model.checkpoint_fingerprint,
+                throughput=model.throughput.state_dict())
+            if model.telemetry is not None:
+                model.telemetry.journal_event(
+                    "checkpoint", path=written,
+                    seconds=round(time.monotonic() - t0, 3))
             if mh.is_coordinator():
                 print(f"checkpointed to {written}")
 
@@ -480,37 +514,59 @@ def main(argv=None) -> bool:
     # only the coordinator creates a run dir (its artifacts are the
     # run's outputs; workers would just litter empty dirs)
     log_dir = make_logdir(cfg) if coord else ""
+    # run journal + on-device metrics + throughput tracking (wiring
+    # shared with the CV driver, owned by the telemetry package)
+    from commefficient_tpu.telemetry import attach_run_telemetry
+    tele = attach_run_telemetry(model, cfg, log_dir, coord,
+                                driver="gpt2_train",
+                                materialize=mh.gather_host)
     if coord:
         print(f"Finished initializing in {timer():.2f} seconds")
 
-    if cfg.do_finetune:
-        test_gpt2(model, val_loader, timer=timer,
-                  logger=TableLogger() if coord else NullLogger())
-        ok = True
-    else:
-        ok = train_gpt2(model, opt, lr_scheduler, train_loader,
-                        cfg, logger=TableLogger() if coord else NullLogger(),
-                        timer=timer, log_dir=log_dir)
-        save_checkpoint(os.path.join(log_dir, "gpt2"), model.server,
-                        scheduler_step=lr_scheduler.step_count)
-        if cfg.do_checkpoint:
-            # stamped + manifest (what --resume prefers) AND the
-            # fixed-name artifact, in one collective gather
-            save_final(ckpt_path, model.server, model.clients,
-                       keep_last=cfg.keep_checkpoints,
-                       max_age_hours=cfg.ckpt_max_age_hours,
-                       scheduler_step=lr_scheduler.step_count,
-                       accountant=model.accountant,
-                       prev_change_words=model._prev_change_words,
-                       fingerprint=model.checkpoint_fingerprint)
-        # HF-style final artifact: tokenizer + config + weights
-        # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
-        if coord:
-            save_pretrained(log_dir, model.state_dict(), module.cfg,
-                            tokenizer)
-        test_gpt2(model, val_loader, timer=timer,
-                  logger=TableLogger() if coord else NullLogger())
-    model.finalize()
+    ok = False
+    try:
+        if cfg.do_finetune:
+            test_gpt2(model, val_loader, timer=timer,
+                      logger=TableLogger() if coord else NullLogger())
+            ok = True
+        else:
+            ok = train_gpt2(model, opt, lr_scheduler, train_loader,
+                            cfg,
+                            logger=TableLogger() if coord
+                            else NullLogger(),
+                            timer=timer, log_dir=log_dir)
+            save_checkpoint(os.path.join(log_dir, "gpt2"), model.server,
+                            scheduler_step=lr_scheduler.step_count)
+            if cfg.do_checkpoint:
+                # stamped + manifest (what --resume prefers) AND the
+                # fixed-name artifact, in one collective gather
+                save_final(ckpt_path, model.server, model.clients,
+                           keep_last=cfg.keep_checkpoints,
+                           max_age_hours=cfg.ckpt_max_age_hours,
+                           scheduler_step=lr_scheduler.step_count,
+                           accountant=model.accountant,
+                           prev_change_words=model._prev_change_words,
+                           fingerprint=model.checkpoint_fingerprint,
+                           throughput=model.throughput.state_dict())
+            # HF-style final artifact: tokenizer + config + weights
+            # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
+            if coord:
+                save_pretrained(log_dir, model.state_dict(), module.cfg,
+                                tokenizer)
+            # the final eval legitimately first-compiles after the
+            # train loop's steady state — not a retrace warning
+            with (tele.expect_compiles("final eval") if tele is not None
+                  else contextlib.nullcontext()):
+                test_gpt2(model, val_loader, timer=timer,
+                          logger=TableLogger() if coord
+                          else NullLogger())
+        model.finalize()
+    finally:
+        # close even when training raises (fault drill, NaN abort):
+        # the global compile listener and any live profiler capture
+        # must not leak into the next in-process run
+        if tele is not None:
+            tele.close(ok=bool(ok))
     return ok
 
 

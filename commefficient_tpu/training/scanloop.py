@@ -9,6 +9,8 @@ metric rows differs, so that part is the `emit` callback.
 """
 from __future__ import annotations
 
+import contextlib
+import time
 from typing import Callable, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
@@ -20,7 +22,8 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
                        on_comm: Optional[Callable[[np.ndarray, np.ndarray],
                                                   None]] = None,
                        on_flush: Optional[Callable[[int], None]] = None,
-                       checkpoint: Optional[Callable[[], None]] = None
+                       checkpoint: Optional[Callable[[], None]] = None,
+                       guard: Optional[Callable] = None
                        ) -> bool:
     """Drive scanned spans over `stream`, which yields
     (tag, client_ids, data_tuple, mask, lr) per round — the caller owns
@@ -45,16 +48,42 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
     closure over utils/checkpoint.save_rotating; tests prove resume
     from the hook's checkpoint is bit-exact to the uninterrupted run.
 
+    `guard` is the --debug_transfer_guard hook: a context-manager
+    factory (analysis/runtime.forbid_transfers) armed around every
+    span's dispatch EXCEPT the model's first — the first span compiles
+    its scanned program, everything after is the steady state whose
+    zero-implicit-transfer contract the guard enforces at runtime.
+    The span index lives ON THE MODEL (`_spans_dispatched`), because
+    the drivers call run_scanned_rounds once per epoch: a local
+    counter would re-exempt (and re-profile) each epoch's first span,
+    which is long past compilation.
+
+    A model with an attached telemetry.TelemetrySession additionally
+    gets jax.profiler capture of --profile_spans span indices (global
+    across the run, same model-held counter): the session's
+    span_profile_begin/end bracket each flush, so the trace covers
+    exactly the requested spans' real device work.
+
     Returns True if every emit succeeded, False on abort.
     """
     ids, datas, masks, lrs, tags = [], [], [], [], []
 
     def flush() -> bool:
-        out = model.run_rounds(
-            np.stack(ids),
-            tuple(np.stack([dd[i] for dd in datas])
-                  for i in range(len(datas[0]))),
-            np.stack(masks), np.asarray(lrs))
+        span_idx = getattr(model, "_spans_dispatched", 0)
+        tele = getattr(model, "telemetry", None)
+        if tele is not None:
+            tele.span_profile_begin(span_idx)
+        ctx = (guard() if guard is not None and span_idx > 0
+               else contextlib.nullcontext())
+        with ctx:
+            out = model.run_rounds(
+                np.stack(ids),
+                tuple(np.stack([dd[i] for dd in datas])
+                      for i in range(len(datas[0]))),
+                np.stack(masks), np.asarray(lrs))
+        if tele is not None:
+            tele.span_profile_end(span_idx)
+        model._spans_dispatched = span_idx + 1
         *metric_rows, down, up = out
         if on_flush is not None:
             on_flush(len(ids))
@@ -104,6 +133,7 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
         spans_done[0] += 1
         if spans_done[0] % cfg.ckpt_every_spans:
             return
+        t0 = time.monotonic()
         path = save_rotating(
             prefix, model.server, model.clients,
             keep_last=cfg.keep_checkpoints,
@@ -111,7 +141,15 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
             scheduler_step=lr_scheduler.step_count,
             accountant=model.accountant,
             prev_change_words=model._prev_change_words,
-            fingerprint=model.checkpoint_fingerprint)
+            fingerprint=model.checkpoint_fingerprint,
+            throughput=model.throughput.state_dict())
+        tele = getattr(model, "telemetry", None)
+        if tele is not None:
+            # the save is a full state gather + disk write — exactly
+            # the wall-clock span the journal exists to attribute
+            tele.journal_event("checkpoint", path=path,
+                               seconds=round(time.monotonic() - t0, 3),
+                               span_boundary=True)
         if mh.is_coordinator():
             print(f"checkpointed to {path}")
 

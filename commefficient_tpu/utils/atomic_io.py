@@ -34,6 +34,53 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
+def atomic_append_line(path: str, line: str) -> None:
+    """Append ONE self-delimited line (a JSONL record) durably.
+    See atomic_append_lines for the crash-safety argument."""
+    atomic_append_lines(path, (line,))
+
+
+def atomic_append_lines(path: str, lines, check_tail: bool = True) -> None:
+    """Append self-delimited lines (JSONL records) durably, with ONE
+    flush+fsync for the whole batch.
+
+    Appends are the one write shape `.tmp` + os.replace cannot express
+    (replacing would rewrite committed history and race concurrent
+    appenders), so the crash-safety argument here is different: every
+    line is a self-contained record, the batch is flushed and fsynced
+    before returning, and a preemption mid-write can tear at most the
+    FINAL line — which journal readers (telemetry/journal.py) detect
+    and report without losing any committed record. Batching matters
+    at span boundaries: N+1 records produced at the same instant cost
+    one fsync, not N+1 sequential ones. Before appending, a torn tail
+    left by a previous process's mid-write preemption is sealed with a
+    newline, so the fragment stays ITS OWN (detectably invalid) line
+    instead of silently corrupting the first new record; a torn tail
+    can only predate THIS process's first append, so long-lived
+    writers pass check_tail=False after their first call (RunJournal
+    does) to skip the redundant read-check per record. This is the one
+    sanctioned append implementation; callers must not grow private
+    `open(..., "a")` copies.
+    """
+    seal = b""
+    if check_tail:
+        try:
+            with open(path, "rb") as rf:
+                rf.seek(-1, os.SEEK_END)
+                if rf.read(1) != b"\n":
+                    seal = b"\n"
+        except (OSError, ValueError):
+            pass  # missing or empty file: nothing to seal
+    data = seal + "".join(f"{ln}\n" for ln in lines).encode()
+    f = open(path, "ab")  # graftlint: disable=GL006 -- sanctioned append-only JSONL path; torn-tail-sealing, see docstring
+    try:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    finally:
+        f.close()
+
+
 def atomic_save(path: str, arr) -> None:
     """np.save to `path` atomically. Like atomic_savez, the tmp file is
     opened explicitly so np.save cannot append `.npy` to the tmp name —

@@ -87,13 +87,16 @@ def validate_fingerprint(found: dict, expected: dict,
 
 class Checkpoint(NamedTuple):
     """Loaded training state; accounting state rides along so resumed
-    runs keep cumulative comm totals correct."""
+    runs keep cumulative comm totals correct, and the per-client
+    throughput-tracker state (telemetry/clients.py) so measured
+    client speeds survive preemption bit-exactly."""
     server: ServerState
     clients: Optional[ClientState]
     scheduler_step: int
     accountant_state: Optional[dict] = None
     prev_change_words: Optional[np.ndarray] = None
     fingerprint: Optional[dict] = None
+    throughput: Optional[dict] = None
 
 
 def save_checkpoint(path: str, server: ServerState,
@@ -103,7 +106,8 @@ def save_checkpoint(path: str, server: ServerState,
                     accountant=None,
                     prev_change_words: Optional[np.ndarray] = None,
                     chunk_rows: int = 256,
-                    fingerprint: Optional[dict] = None) -> str:
+                    fingerprint: Optional[dict] = None,
+                    throughput: Optional[dict] = None) -> str:
     """Write training state to `path` (.npz appended if absent).
     Per-client state can be excluded (include_clients=False) to keep
     files small when clients are stateless (error_type != local and
@@ -144,6 +148,11 @@ def save_checkpoint(path: str, server: ServerState,
             arrays[f"acct_{k}"] = v
     if prev_change_words is not None:
         arrays["acct_prev_change_words"] = np.asarray(prev_change_words)
+    if throughput is not None:
+        # per-client throughput-tracker state (telemetry/clients.py
+        # state_dict()); plain arrays, so the resume is bit-exact
+        for k, v in throughput.items():
+            arrays[f"thr_{k}"] = np.asarray(v)
     if fingerprint is not None:
         for k in FINGERPRINT_FIELDS:
             arrays[f"fp_{k}"] = np.asarray(str(fingerprint[k]))
@@ -224,8 +233,10 @@ def load_checkpoint(path: str,
             if k.startswith("acct_") and k != "acct_prev_change_words"}
     prev = (z["acct_prev_change_words"]
             if "acct_prev_change_words" in z.files else None)
+    thr = {k[len("thr_"):]: z[k] for k in z.files
+           if k.startswith("thr_")}
     return Checkpoint(server, clients, int(z["scheduler_step"]),
-                      acct or None, prev, fingerprint)
+                      acct or None, prev, fingerprint, thr or None)
 
 
 # ---------------- keep-last-k rotation + latest manifest -----------------

@@ -18,16 +18,30 @@ class Logger:
 
 
 class TableLogger:
-    """Fixed-width column table on stdout; header from the first row."""
+    """Fixed-width column table on stdout; header from the first row.
+
+    Schema-drift tolerant: rows may GAIN keys mid-run (new columns are
+    appended and the header reprints once) or LOSE keys (the missing
+    cell renders as '-') — a driver that adds a metric after round 1,
+    or an epoch row that skips an optional field, no longer dies with
+    a KeyError halfway through a multi-hour run."""
+
+    _MISSING = object()
 
     def append(self, output: dict):
+        fresh = [k for k in output if k not in getattr(self, "keys", ())]
         if not hasattr(self, "keys"):
-            self.keys = list(output.keys())
+            self.keys = list(fresh)
+            print(*(f"{k:>12s}" for k in self.keys))
+        elif fresh:
+            self.keys.extend(fresh)
             print(*(f"{k:>12s}" for k in self.keys))
         row = []
         for k in self.keys:
-            v = output[k]
-            if isinstance(v, (float, np.floating)):
+            v = output.get(k, self._MISSING)
+            if v is self._MISSING:
+                row.append(f"{'-':>12}")
+            elif isinstance(v, (float, np.floating)):
                 row.append(f"{v:12.4f}")
             else:
                 row.append(f"{v!s:>12}")
@@ -43,14 +57,46 @@ class NullLogger:
         pass
 
 
+class TSVColumn:
+    """One TSV column: header name, the row key it reads, a format
+    spec, and a multiplicative scale applied before formatting."""
+
+    def __init__(self, header: str, key: str, fmt: str = "{}",
+                 scale: float = 1.0):
+        self.header, self.key, self.fmt, self.scale = header, key, fmt, scale
+
+    def render(self, row: dict) -> str:
+        if self.key not in row:
+            return ""  # schema-tolerant: a missing source key is blank
+        v = row[self.key]
+        if self.scale != 1.0 and isinstance(
+                v, (int, float, np.integer, np.floating)):
+            v = v * self.scale
+        return self.fmt.format(v)
+
+
+# the reference's hard-coded epoch/hours/top1Accuracy schema
+# (CommEfficient/utils.py TSVLogger), now just the default column spec
+LEGACY_TSV_COLUMNS = (
+    TSVColumn("epoch", "epoch"),
+    TSVColumn("hours", "total_time", "{:.8f}", 1.0 / 3600),
+    TSVColumn("top1Accuracy", "test_acc", "{:.2f}", 100.0),
+)
+
+
 class TSVLogger:
-    def __init__(self):
-        self.log = ["epoch,hours,top1Accuracy"]
+    """Schema-driven TSV accumulator. The column spec is data (a
+    sequence of TSVColumn), not code: pass your own columns to record
+    any row schema; the default reproduces the reference's
+    epoch,hours,top1Accuracy format byte for byte. Rows missing a
+    column's source key render that cell blank instead of raising."""
+
+    def __init__(self, columns=LEGACY_TSV_COLUMNS):
+        self.columns = tuple(columns)
+        self.log = [",".join(c.header for c in self.columns)]
 
     def append(self, output: dict):
-        self.log.append("{},{:.8f},{:.2f}".format(
-            output["epoch"], output["total_time"] / 3600,
-            output["test_acc"] * 100))
+        self.log.append(",".join(c.render(output) for c in self.columns))
 
     def __str__(self):
         return "\n".join(self.log)

@@ -72,13 +72,19 @@ def with_retries(fn: Callable[[], T], *,
                  = is_transient_error,
                  describe: str = "operation",
                  sleep: Callable[[float], None] = time.sleep,
-                 logger: Optional[Logger] = None) -> T:
+                 logger: Optional[Logger] = None,
+                 on_retry: Optional[Callable[
+                     [int, BaseException, float], None]] = None) -> T:
     """Call `fn()`; on a failure `classify` marks transient, retry up
     to `retries` more times with exponential backoff (base_delay *
     backoff^attempt, capped at max_delay). Fatal failures — and the
     final transient one once the bound is exhausted — re-raise
     unchanged. Each retry is logged through utils/logging.Logger so a
-    pod run's recovery attempts are visible in its stdout record."""
+    pod run's recovery attempts are visible in its stdout record;
+    `on_retry(attempt, exc, delay)` additionally fires before each
+    backoff sleep — the telemetry journal's hook, so a pod run's
+    recovery attempts land in its structured record too
+    (telemetry/journal.py `retry` events)."""
     logger = logger or Logger()
     delay = base_delay
     for attempt in range(retries + 1):
@@ -87,6 +93,8 @@ def with_retries(fn: Callable[[], T], *,
         except Exception as exc:
             if attempt >= retries or not classify(exc):
                 raise
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
             logger.warn(
                 f"transient failure in {describe} "
                 f"(attempt {attempt + 1}/{retries + 1}): {exc!r}; "

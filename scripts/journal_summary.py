@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Validate + summarize a telemetry run journal (JSONL).
+
+The cheap CI check of the journal invariants (ISSUE 4 satellite):
+scripts/tier1.sh runs a tiny driver smoke with the journal on and then
+this tool over the result — a malformed line, a wrong schema version,
+or a duplicate/out-of-order round event fails the build, so the record
+format every perf investigation depends on cannot silently rot.
+
+Usage:
+    python scripts/journal_summary.py <journal.jsonl> [--quiet]
+
+Exit codes: 0 valid journal, 1 invariant violations (listed on
+stderr), 2 unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from commefficient_tpu.telemetry.journal import (  # noqa: E402
+    summarize, validate_journal,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("journal", help="path to a journal.jsonl")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the summary line (problems still "
+                        "print to stderr)")
+    args = p.parse_args(argv)
+
+    try:
+        records, problems = validate_journal(args.journal)
+    except OSError as e:
+        print(f"journal_summary: cannot read {args.journal!r}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if not records and not problems:
+        problems = ["journal is empty (no records at all)"]
+
+    if not args.quiet:
+        print(json.dumps(summarize(records)))
+    if problems:
+        for prob in problems:
+            print(f"journal_summary: INVALID: {prob}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

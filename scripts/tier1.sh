@@ -18,4 +18,25 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# telemetry smoke + journal invariant check (ISSUE 4 satellite): a
+# tiny scanned driver run with the journal and the steady-state
+# transfer guard armed, then scripts/journal_summary.py over the
+# journal it wrote — malformed or duplicate-round events fail tier-1.
+# Only runs when the pytest gate above already passed.
+if [ "$rc" -eq 0 ]; then
+  JR=/tmp/_t1_journal.jsonl
+  rm -f "$JR"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode uncompressed \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --scan_rounds --scan_span 1 --debug_transfer_guard \
+      --journal_path "$JR" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "TELEMETRY_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR" \
+      || { echo "JOURNAL_INVALID"; exit 1; }
+fi
 exit $rc

@@ -93,6 +93,48 @@ def test_smoke_dropout_rotating_checkpoint_resume(tmp_path):
                     "--checkpoint_path", ck, "--num_epochs", "0.1")
 
 
+def test_smoke_scan_transfer_guard_and_journal(tmp_path):
+    """ISSUE 4 satellites: --debug_transfer_guard arms
+    forbid_transfers over every steady-state span (--scan_span 1 makes
+    the 2-round run produce a guarded second span), and the run
+    journal the driver writes validates cleanly with per-round named
+    metrics."""
+    from commefficient_tpu.telemetry import tmetrics
+    from commefficient_tpu.telemetry.journal import validate_journal
+
+    jr = str(tmp_path / "journal.jsonl")
+    assert run_main(tmp_path, "--mode", "uncompressed", "--scan_rounds",
+                    "--scan_span", "1", "--debug_transfer_guard",
+                    "--journal_path", jr)
+    records, problems = validate_journal(jr)
+    assert not problems, problems
+    kinds = {r["event"] for r in records}
+    assert {"run_start", "span", "round", "epoch", "run_end"} <= kinds
+    spans = [r for r in records if r["event"] == "span"]
+    assert len(spans) >= 2  # second span onward dispatched under guard
+    rounds = [r for r in records if r["event"] == "round"]
+    assert set(rounds[0]["metrics"]) == set(tmetrics.METRIC_NAMES)
+
+
+def test_smoke_unscanned_transfer_guard(tmp_path):
+    """The per-round driver loop is ALSO transfer-guard-clean in
+    steady state (the guard caught — and the fix removed — the
+    implicit python-float lr upload every round used to perform)."""
+    assert run_main(tmp_path, "--mode", "sketch",
+                    "--error_type", "virtual",
+                    "--virtual_momentum", "0.9",
+                    "--debug_transfer_guard")
+
+
+def test_smoke_no_telemetry(tmp_path):
+    """--no_telemetry traces the metric-free round program and writes
+    no journal."""
+    import glob
+    assert run_main(tmp_path, "--mode", "uncompressed", "--no_telemetry",
+                    "--journal_path", str(tmp_path / "off.jsonl"))
+    assert not glob.glob(str(tmp_path / "off.jsonl"))
+
+
 def test_finetune_head_swap(tmp_path):
     ck = str(tmp_path / "ck")
     assert run_main(tmp_path, "--mode", "uncompressed",
