@@ -364,6 +364,34 @@ def main() -> int:
         except Exception as e:
             log(f"bf16 measurement failed: {e}")
 
+    # scheduler-mode measurement (ISSUE 5 satellite): the scheduled
+    # round is the SAME scanned program carrying the survivor + work
+    # operands a deadline-driven round rides (round.py's third traced
+    # program) — this measures the device-side cost of scheduling so
+    # future BENCH_*.json can compare scheduled vs uniform rounds.
+    # Deterministic work fractions emulate a 0.9-quantile deadline
+    # truncating ~10% of slots; survivors stay all-ones (idle-slot
+    # over-provisioning is the dropout path, already the surv program).
+    sched_round_ms = None
+    try:
+        rngw = np.random.RandomState(7)
+        work = np.ones((ROUNDS, NUM_WORKERS), np.float32)
+        trunc = rngw.rand(ROUNDS, NUM_WORKERS) < 0.1
+        work[trunc] = rngw.uniform(0.5, 0.95, int(trunc.sum()))
+        batches_sched = batches._replace(
+            survivors=jnp.ones((ROUNDS, NUM_WORKERS), jnp.float32),
+            work=jnp.asarray(work))
+        with alarm_guard(STAGE_TIMEOUT, "scheduled compile+measure"):
+            float(np.asarray(run_digest(server, clients, batches_sched,
+                                        lrs, key)))  # compile
+            sched_round_ms = median_ms(
+                run_digest, (server, clients, batches_sched, lrs, key),
+                divisor=ROUNDS)
+    except StageTimeout:
+        log("scheduled-round measurement timed out; omitting")
+    except Exception as e:
+        log(f"scheduled-round measurement failed: {e}")
+
     out = {
         "metric": "cifar10_resnet9_sketch_round_time",
         "value": round(round_ms, 3),
@@ -380,6 +408,12 @@ def main() -> int:
     if bf16_round_ms is not None:
         out["value_bf16"] = round(bf16_round_ms, 3)
         out["vs_baseline_bf16"] = round(ref_round_ms / bf16_round_ms, 3)
+    if sched_round_ms is not None:
+        # scheduled (survivor+work operand) round next to the uniform
+        # one: vs_uniform < 1.0 means the scheduling operands cost
+        # device time, > 1.0 means the truncated work actually saved it
+        out["value_scheduled"] = round(sched_round_ms, 3)
+        out["vs_uniform_scheduled"] = round(round_ms / sched_round_ms, 3)
     add_flops_fields(out, flops_per_round, round_ms, device_kind)
     print(json.dumps(out), flush=True)
     return 0
@@ -581,6 +615,17 @@ def orchestrate() -> int:
                "value": None, "unit": "ms/round", "vs_baseline": None,
                "error": "all bench children failed or timed out"}
     journal_digest(out, "bench_digest")
+    if out.get("value_scheduled") is not None:
+        # dedicated scheduler-mode digest (ISSUE 5 satellite): a
+        # BENCH_*.json consumer comparing scheduled vs uniform rounds
+        # gets its own record in the shared schema
+        journal_digest({
+            "metric": "cifar10_resnet9_sketch_round_time_scheduled",
+            "value": out["value_scheduled"],
+            "unit": out.get("unit", "ms/round"),
+            "vs_uniform": out.get("vs_uniform_scheduled"),
+            "platform": out.get("platform"),
+        }, "bench_digest")
     if out.get("platform") != "tpu":
         # the axon tunnel flaps for hours at a time; a degraded run
         # should still point the reader at the newest validated TPU

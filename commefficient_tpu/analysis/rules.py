@@ -509,6 +509,54 @@ def check_gl006(module: ModuleInfo) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GL007 — unconstrained shard_map/pjit output layouts
+
+# transform -> (keyword that pins its output layout, positional arg
+# count that reaches the same slot: shard_map(f, mesh, in_specs,
+# out_specs) and pjit(f, in_shardings, out_shardings) are both legal
+# positional forms)
+_GL007_CALLS = {"shard_map": ("out_specs", 4),
+                "pjit": ("out_shardings", 3)}
+
+
+def check_gl007(module: ModuleInfo) -> Iterator[Violation]:
+    """A `shard_map(...)` / `pjit(...)` call without an explicit
+    `out_specs` / `out_shardings` leaves the output layout to GSPMD's
+    propagation: on a partially-manual mesh (the engine's clients-
+    manual / model-auto layout) that silently inserts reshards on new
+    outputs instead of failing — the layout bug class the PR-3
+    ROADMAP opening named. Mechanical and precise: only the literal
+    call sites are checked; a call forwarding **kwargs, or passing
+    enough positional args to cover the out-spec slot, is left alone
+    (the spec may ride there), matching the lint's precision-over-
+    recall rule."""
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        term = _terminal(_dotted(node.func))
+        spec = _GL007_CALLS.get(term)
+        if spec is None:
+            continue
+        kwname, pos_count = spec
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **kwargs forwarding: can't see the spec
+        if (len(node.args) >= pos_count
+                or any(isinstance(a, ast.Starred) for a in node.args)):
+            continue  # positional form (or *args) covers the slot
+        kw = next((kw.value for kw in node.keywords
+                   if kw.arg == kwname), None)
+        if kw is None or (isinstance(kw, ast.Constant)
+                          and kw.value is None):
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL007",
+                f"`{term}(...)` without an explicit `{kwname}`: the "
+                "output layout is left to GSPMD propagation, which "
+                "silently reshards new outputs on partially-manual "
+                f"meshes; pass `{kwname}` (or pin each output with "
+                "with_sharding_constraint before returning)")
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "GL001": check_gl001,
@@ -517,6 +565,7 @@ ALL_RULES = {
     "GL004": check_gl004,
     "GL005": check_gl005,
     "GL006": check_gl006,
+    "GL007": check_gl007,
 }
 
 RULE_DOCS = {
@@ -531,4 +580,6 @@ RULE_DOCS = {
     "GL005": "broad except handler that would swallow InjectedFault "
              "(no re-raise)",
     "GL006": "file write without the atomic .tmp + os.replace pattern",
+    "GL007": "shard_map/pjit output layout left unconstrained (no "
+             "out_specs/out_shardings, no with_sharding_constraint)",
 }

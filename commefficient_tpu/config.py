@@ -245,6 +245,33 @@ class Config:
     l2_norm_clip: float = 1.0
     noise_multiplier: float = 0.0
 
+    # round scheduling (commefficient_tpu/scheduler, ISSUE 5): the
+    # telemetry substrate's consumer. `sampler` picks the participant
+    # policy — "uniform" is BIT-IDENTICAL to the pre-scheduler draw
+    # (the default), "throughput" deprioritizes chronically slow
+    # clients by their measured EMA examples/sec with an exploration
+    # floor (`explore_floor`: every alive client keeps at least
+    # floor/num_alive selection probability per slot so it keeps
+    # getting measured). Throughput draws live on their own PRNG
+    # domain, distinct from the dropout/straggler streams.
+    sampler: str = "uniform"
+    explore_floor: float = 0.1
+    # deadline-driven rounds: 0 = off; otherwise each round's
+    # wall-clock deadline is this quantile of the participants'
+    # measured time estimates, and participants estimated past it get
+    # work fractions deadline/estimate (floored at deadline_min_work)
+    # on the EXISTING straggler work operand — deadline aggregation
+    # stays inside the jitted round, three traced programs unchanged.
+    # Unmeasured participants are never truncated (scheduler/deadline).
+    deadline_quantile: float = 0.0
+    deadline_min_work: float = 0.1
+    # over-provisioning: sample ceil(target / expected-survival-rate)
+    # participants (capped at num_workers) so EXPECTED survivors hit
+    # this target; surplus compiled slots ride as survivor-mask zeros
+    # (bit-exactly the dropped-client path). 0 = no target: fill every
+    # slot, the pre-scheduler behavior.
+    target_survivors: int = 0
+
     # set after model construction (reference mutates args.grad_size at
     # fed_aggregator.py:88; we return a new frozen Config instead)
     grad_size: int = 0
@@ -404,6 +431,54 @@ class Config:
                 raise ValueError(
                     "--profile_spans requires telemetry (drop "
                     "--no_telemetry: the session drives the capture)")
+        if self.sampler not in ("uniform", "throughput"):
+            raise ValueError(
+                f"unknown sampler {self.sampler!r} (choices: uniform, "
+                "throughput — commefficient_tpu/scheduler)")
+        if not 0.0 <= self.explore_floor <= 1.0:
+            raise ValueError(
+                f"explore_floor={self.explore_floor} must be in [0, 1] "
+                "(1.0 degenerates throughput sampling to uniform)")
+        if not 0.0 <= self.deadline_quantile <= 1.0:
+            raise ValueError(
+                f"deadline_quantile={self.deadline_quantile} must be "
+                "in [0, 1] (0 = no deadline)")
+        if not 0.0 < self.deadline_min_work <= 1.0:
+            raise ValueError(
+                f"deadline_min_work={self.deadline_min_work} must be "
+                "in (0, 1] — zero work is dropout, not a deadline "
+                "truncation (use straggler_cutoff for degradation)")
+        if self.target_survivors < 0:
+            raise ValueError("target_survivors must be >= 0 (0 = fill "
+                             "every participant slot)")
+        if self.target_survivors > self.num_workers:
+            raise ValueError(
+                f"target_survivors={self.target_survivors} exceeds "
+                f"num_workers={self.num_workers}: a round cannot "
+                "produce more survivors than compiled participant "
+                "slots")
+        if not self.telemetry and (self.sampler != "uniform"
+                                   or self.deadline_quantile > 0):
+            # without the telemetry session nothing ever feeds the
+            # throughput tracker, so these policies would silently
+            # degenerate (uniform-with-floor sampling, a deadline that
+            # never fires) — same fail-loud rule as --profile_spans.
+            # --target_survivors is fine: its survival estimate falls
+            # back to the 1 - client_dropout prior.
+            raise ValueError(
+                "--sampler throughput / --deadline_quantile require "
+                "telemetry (drop --no_telemetry: the session feeds "
+                "the throughput measurements these policies read)")
+        if self.multihost and (self.sampler != "uniform"
+                               or self.deadline_quantile > 0
+                               or self.target_survivors > 0):
+            raise ValueError(
+                "scheduler policies (--sampler throughput / "
+                "--deadline_quantile / --target_survivors) are "
+                "single-controller only for now: decisions derive from "
+                "process-local wall-clock throughput measurements and "
+                "would diverge across controllers (coordinator-"
+                "broadcast scheduling is the named ROADMAP opening)")
         if self.down_k < 0:
             raise ValueError("down_k must be >= 0 (0 = share the upload k)")
         if self.down_k > self.grad_size > 0:
@@ -508,6 +583,30 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                         "save is a full state gather — raise k to "
                         "bound the save rate; 0 = epoch cadence only)")
 
+    p.add_argument("--sampler", choices=("uniform", "throughput"),
+                   default="uniform",
+                   help="participant-sampling policy: uniform (bit-"
+                        "identical to the pre-scheduler draw) or "
+                        "throughput (deprioritize measured-slow "
+                        "clients; commefficient_tpu/scheduler)")
+    p.add_argument("--explore_floor", type=float, default=0.1,
+                   help="throughput sampler's exploration floor: every "
+                        "alive client keeps >= floor/num_alive "
+                        "selection probability per slot")
+    p.add_argument("--deadline_quantile", type=float, default=0.0,
+                   help="per-round wall-clock deadline as this "
+                        "quantile of participants' measured time "
+                        "estimates; slower participants get truncated "
+                        "work fractions on the straggler operand "
+                        "(0 = no deadline)")
+    p.add_argument("--deadline_min_work", type=float, default=0.1,
+                   help="floor of a deadline-truncated work fraction "
+                        "(fractions below --straggler_cutoff still "
+                        "degrade to dropout)")
+    p.add_argument("--target_survivors", type=int, default=0,
+                   help="over-provision sampling so expected round "
+                        "survivors hit this count; surplus slots ride "
+                        "as survivor-mask zeros (0 = fill all slots)")
     p.add_argument("--port", type=int, default=5315)
     p.add_argument("--num_clients", type=int)
     p.add_argument("--num_workers", type=int, default=1)

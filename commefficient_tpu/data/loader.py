@@ -66,15 +66,31 @@ class FedLoader:
             per_client = []
             for w in rows:
                 n_valid = int(r.mask[w].sum())
-                got = self.dataset.get_client_batch(
+                # idle slots (a scheduler that over-provisioned fewer
+                # than num_workers pads with zero-mask rows) fetch
+                # nothing: their buffer rows stay zeros and the round
+                # engine sees them as survivor-0 dead slots
+                got = (self.dataset.get_client_batch(
                     int(r.client_ids[w]), r.idx_within[w, :n_valid])
+                    if n_valid else None)
                 per_client.append((n_valid, got))
-            # allocate static [W_local, B, ...] buffers from the first fetch
-            protos = per_client[0][1]
+            # allocate static [W_local, B, ...] buffers from the first
+            # real fetch (slot 0 is always active in single-controller
+            # runs — the scheduler selects at least one participant)
+            protos = next((got for _, got in per_client
+                           if got is not None), None)
+            if protos is None:
+                raise NotImplementedError(
+                    "every row this process feeds is an idle "
+                    "(zero-mask) slot; feeding cannot derive batch "
+                    "shapes — scheduler over-provisioning is single-"
+                    "controller only (Config.validate enforces this)")
             data = tuple(
                 np.zeros((len(rows), B) + p.shape[1:], p.dtype)
                 for p in protos)
             for i, (n_valid, got) in enumerate(per_client):
+                if got is None:
+                    continue
                 for buf, g in zip(data, got):
                     buf[i, :n_valid] = g
             mask = (r.mask if self.feed_slice is None

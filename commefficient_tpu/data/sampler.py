@@ -33,13 +33,29 @@ class FedSampler:
     def __init__(self, data_per_client: np.ndarray, num_workers: int,
                  local_batch_size: int, seed: int = 0,
                  shuffle_clients: bool = True,
-                 max_local_batch: int = -1):
+                 max_local_batch: int = -1, scheduler=None):
         """max_local_batch caps the static batch dim B when
         local_batch_size == -1 (whole-client batches): a client with
         more data than the cap stays non-exhausted and participates in
         consecutive rounds on successive chunks. Bounds the
         [num_workers, B, ...] staging arrays that are otherwise sized
-        by max(data_per_client) — the ImageNet-scale memory hazard."""
+        by max(data_per_client) — the ImageNet-scale memory hazard.
+
+        scheduler: optional RoundScheduler (commefficient_tpu/
+        scheduler; also settable post-construction — drivers attach
+        via scheduler.attach_round_scheduler). When set, participant
+        selection is delegated to its policy; the UNIFORM default
+        makes the byte-identical `rng.choice` call this class made
+        before the scheduler existed, so the drawn stream — and
+        everything downstream — is bit-unchanged. A policy may select
+        FEWER than num_workers clients (over-provisioning targets);
+        the surplus slots are padded with distinct UNCHOSEN client
+        ids carrying all-zero masks — the scheduler marks them dead
+        (survivor 0) so the jitted round leaves their state rows
+        bit-untouched and accounting charges them nothing. Pad ids
+        must be distinct from the chosen ids: the round engine's
+        scatter-back writes every slot's row, and a duplicate
+        alive/dead id pair would race the alive client's update."""
         self.data_per_client = np.asarray(data_per_client)
         self.num_clients = len(self.data_per_client)
         self.num_workers = num_workers
@@ -47,6 +63,7 @@ class FedSampler:
         self.max_local_batch = max_local_batch
         self.rng = np.random.RandomState(seed)
         self.shuffle_clients = shuffle_clients
+        self.scheduler = scheduler
         if num_workers > self.num_clients:
             raise ValueError(
                 f"num_workers={num_workers} > num_clients={self.num_clients}")
@@ -88,7 +105,26 @@ class FedSampler:
             alive = np.where(cursor < dpc)[0]
             if len(alive) < self.num_workers:
                 return
-            chosen = self.rng.choice(alive, self.num_workers, replace=False)
+            if self.scheduler is not None:
+                # policy selection (possibly < num_workers under an
+                # over-provisioning target); the uniform default makes
+                # the identical rng.choice call the branch below does
+                chosen = np.asarray(self.scheduler.select(
+                    alive, self.num_workers, self.rng))
+            else:
+                chosen = self.rng.choice(alive, self.num_workers,
+                                         replace=False)
+            if len(chosen) < self.num_workers:
+                # idle-slot padding: distinct ids NOT chosen this
+                # round (num_clients >= num_workers guarantees
+                # enough), zero-mask rows, cursor untouched — the
+                # scheduler's plan marks them survivor-0
+                pad = np.setdiff1d(np.arange(self.num_clients),
+                                   chosen)[:self.num_workers
+                                           - len(chosen)]
+                slot_ids = np.concatenate([chosen, pad])
+            else:
+                slot_ids = chosen
             idx = np.zeros((self.num_workers, B), np.int32)
             mask = np.zeros((self.num_workers, B), np.float32)
             for w, cid in enumerate(chosen):
@@ -100,7 +136,9 @@ class FedSampler:
                 idx[w, :take] = sel
                 mask[w, :take] = 1.0
                 cursor[cid] += take
-            yield RoundIndices(chosen.astype(np.int32), idx, mask)
+            if self.scheduler is not None:
+                self.scheduler.commit_round(slot_ids, mask.sum(axis=1))
+            yield RoundIndices(slot_ids.astype(np.int32), idx, mask)
 
 
 class ValSampler:

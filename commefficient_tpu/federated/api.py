@@ -180,6 +180,20 @@ class FedModel:
         # conductor — is attached by the driver when cfg.telemetry is on
         self.throughput = ClientThroughputTracker(self.num_clients)
         self.telemetry = None
+        # round scheduling (commefficient_tpu/scheduler): the drivers
+        # attach a RoundScheduler whose selection-time plans this
+        # model consumes at dispatch (attach_scheduler); None — or a
+        # default uniform/no-deadline scheduler, which plans nothing —
+        # leaves every code path bit-identical to a scheduler-free
+        # build
+        self.scheduler = None
+        # per-round scheduled-slot masks (RoundPlan.active), stashed
+        # at plan consumption and handed to the telemetry feeding so
+        # idle over-provisioned pads are EXCLUDED from the throughput
+        # tracker (they were never asked to work — counting them as
+        # participations would depress the completion ratio the
+        # scheduler's survival estimate reads)
+        self._plan_active = {}
 
     def attach_telemetry(self, session) -> None:
         """Install a telemetry.TelemetrySession (or None to detach).
@@ -190,6 +204,27 @@ class FedModel:
         self.telemetry = session
         if session is not None and session.tracker is None:
             session.tracker = self.throughput
+
+    def attach_scheduler(self, scheduler) -> None:
+        """Install a scheduler.RoundScheduler (or None to detach). Its
+        per-round plans — idle over-provisioned slots and deadline
+        work fractions — compose into the fault operands in
+        _faults_for_round; scheduler state rides in checkpoints under
+        `sched_*` keys and load_state restores it."""
+        self.scheduler = scheduler
+
+    def scheduler_state(self) -> Optional[dict]:
+        """The `sched_*` checkpoint payload: the attached scheduler's
+        counter state_dict, or None without one — every checkpoint
+        call site passes this, next to throughput.state_dict()."""
+        return (self.scheduler.state_dict()
+                if self.scheduler is not None else None)
+
+    def _scheduler_active(self) -> bool:
+        """True when an attached scheduler can actually produce plans
+        (non-default policy) — the scanned path must then run the
+        fault-composition pass even with dropout/stragglers off."""
+        return self.scheduler is not None and not self.scheduler.is_default
 
     def _journal_fault(self, kind: str, round_idx: int) -> None:
         """Record an InjectedFault about to raise (utils/faults) in the
@@ -277,9 +312,31 @@ class FedModel:
         traces — the bit-identity the cutoff contract promises. When
         work survives, a missing survivor mask is filled with ones:
         the work program always carries both operands (round.py traces
-        exactly three programs)."""
+        exactly three programs).
+
+        A scheduler RoundPlan composes through the SAME operands
+        before the cutoff pass: idle over-provisioned slots zero the
+        survivor mask (bit-exactly the dropped-client path) and
+        deadline fractions min-compose with the straggler draw — the
+        slower cause wins, and a deadline fraction below the straggler
+        cutoff degrades to dropout like any other. The consumed plan
+        is journaled as a `schedule` event, so scheduling decisions
+        are in the run's own record."""
         surv = self._survivors_for_round(round_idx, client_ids)
         work = self._work_for_round(round_idx, client_ids)
+        plan = (self.scheduler.take_plan(round_idx)
+                if self.scheduler is not None else None)
+        if plan is not None:
+            if plan.active is not None:
+                surv = (plan.active if surv is None
+                        else surv * plan.active)
+                self._plan_active[int(round_idx)] = plan.active
+            if plan.work is not None:
+                w = np.asarray(plan.work, np.float32)
+                work = w if work is None else np.minimum(work, w)
+            if self.telemetry is not None:
+                self.telemetry.journal_event("schedule",
+                                             **plan.journal_fields())
         if work is not None:
             work = np.asarray(work, np.float32)
             cutoff = self.cfg.straggler_cutoff
@@ -358,6 +415,10 @@ class FedModel:
             # per-client throughput EMA / participation — bit-exact
             # resume (telemetry/clients.py; test_telemetry proves it)
             self.throughput.load_state_dict(ckpt.throughput)
+        if ckpt.scheduler and self.scheduler is not None:
+            # scheduler counters (sched_* keys) — attach the run's
+            # RoundScheduler BEFORE load_state so this lands
+            self.scheduler.load_state_dict(ckpt.scheduler)
         if ckpt.prev_change_words is not None:
             self._prev_change_words = ckpt.prev_change_words
         # resync the host round mirror so dropout draws / crash points
@@ -455,11 +516,14 @@ class FedModel:
         # return below): hand the session this round's DEVICE metric
         # vector + example counts; it materializes the previous round's
         # (already complete — free) and journals it
+        sched_mask = self._plan_active.pop(this_round, None)
         if self.telemetry is not None:
             self.telemetry.on_round(
                 this_round, np.asarray(client_ids),
                 metrics.telemetry if self.cfg.telemetry else None,
-                metrics.num_examples)
+                metrics.num_examples,
+                comm=(float(download.sum()), float(upload.sum())),
+                scheduled=sched_mask)
 
         # injected preemption: the round above fully completed (state,
         # accounting, round counter) — crash at the exact boundary a
@@ -529,7 +593,8 @@ class FedModel:
         # forces the full [N, W] pair: one scanned program per span.
         surv_all = work_all = None
         if (self.cfg.client_dropout > 0 or self.cfg.straggler_rate > 0
-                or self.fault_schedule is not None):
+                or self.fault_schedule is not None
+                or self._scheduler_active()):
             rows = [self._faults_for_round(first + n, ids_host[n])
                     for n in range(n_rounds)]
             ones = np.ones(ids_host.shape[1], np.float32)
@@ -594,24 +659,12 @@ class FedModel:
         bits_host = jax.device_get(bits)
         t_blocked = time.monotonic()
 
-        # span-boundary telemetry export: ONE explicit device_get of
-        # the [N, M] metric rows + [N, W] example counts, after the
-        # bits transfer already forced span completion — telemetry adds
-        # no sync points, and the explicit gathers keep the span
-        # transfer-guard-clean (test_telemetry proves both)
-        if self.telemetry is not None:
-            tele_rows = (mh.gather_host(metrics.telemetry)
-                         if self.cfg.telemetry else None)
-            counts_rows = mh.gather_host(metrics.num_examples)
-            self.telemetry.on_span(
-                first, ids_host, tele_rows, counts_rows,
-                dispatch_s=t_dispatched - t_dispatch0,
-                block_s=t_blocked - t_dispatched)
         if self._prev_change_words is not None:
             # may still be a device array from a preceding single-round
             # call (the lazy-sync path in _call_train)
             self._prev_change_words = jax.device_get(
                 self._prev_change_words)
+        comm_rows = []
         for n in range(ids_host.shape[0]):
             surv_n = None if surv_all is None else surv_all[n]
             if account:
@@ -620,6 +673,7 @@ class FedModel:
                     survivors=surv_n)
                 download += d
                 upload += u
+                comm_rows.append((float(d.sum()), float(u.sum())))
             else:
                 # keep the change deque and staleness counters in sync
                 # (skipping only the popcount work) so a later accounted
@@ -627,7 +681,29 @@ class FedModel:
                 self.accountant.advance_round(
                     ids_host[n], self._prev_change_words,
                     survivors=surv_n)
+                comm_rows.append(None)
             self._prev_change_words = bits_host[n]
+
+        # span-boundary telemetry export: ONE explicit device_get of
+        # the [N, M] metric rows + [N, W] example counts, after the
+        # bits transfer already forced span completion — telemetry adds
+        # no sync points, and the explicit gathers keep the span
+        # transfer-guard-clean (test_telemetry proves both). Runs after
+        # the accounting loop so each journaled round carries its byte
+        # totals (telemetry/journal `down_bytes`/`up_bytes`).
+        sched_rows = [self._plan_active.pop(first + n, None)
+                      for n in range(ids_host.shape[0])]
+        if all(r is None for r in sched_rows):
+            sched_rows = None
+        if self.telemetry is not None:
+            tele_rows = (mh.gather_host(metrics.telemetry)
+                         if self.cfg.telemetry else None)
+            counts_rows = mh.gather_host(metrics.num_examples)
+            self.telemetry.on_span(
+                first, ids_host, tele_rows, counts_rows,
+                dispatch_s=t_dispatched - t_dispatch0,
+                block_s=t_blocked - t_dispatched,
+                comm_rows=comm_rows, scheduled_rows=sched_rows)
 
         if crash_at is not None:
             # every completed round's state/accounting landed above —

@@ -1,0 +1,119 @@
+"""Deadline-driven rounds: HOW LONG a round may run.
+
+Converts the tracker's per-client time estimates
+(`ClientThroughputTracker.estimate_round_seconds`, PR 4's "deadline
+primitive") into the round engine's EXISTING per-client work-budget
+operand (PR 2: `RoundBatch.work` truncates completed examples /
+local SGD steps inside the jitted round, with FedNova-style
+processed-example reweighting). That is the whole trick: deadline
+aggregation never grows a new device program — the deadline becomes
+work fractions on the host, the fractions ride the third traced
+program that stragglers already ride, and the three-programs contract
+is untouched.
+
+Per round:
+
+  1. estimate each participant's seconds for its batch at its EMA rate;
+  2. the deadline is the `quantile`-th quantile of the FINITE
+     estimates — with q=0.9 the slowest ~10% of measured participants
+     get truncated, everyone else finishes untouched;
+  3. a participant estimated past the deadline gets work fraction
+     `deadline / estimate`, floored at `min_work` (below
+     `Config.straggler_cutoff` the fraction then degrades to the
+     dropout path via the same composition scripted stragglers use —
+     FedModel._faults_for_round);
+  4. UNMEASURED participants (estimate +inf) are never truncated:
+     punishing a client before it has one completed round would starve
+     the measurement the deadline depends on. The sampler's
+     exploration floor keeps such clients flowing through.
+
+Over-provisioning (`Config.target_survivors`) lives here too: FetchSGD
+linearity (sketches of sums = sums of sketches; PAPERS.md 2007.07682)
+makes extra participants nearly free server-side, so when a round
+NEEDS T survivors the scheduler samples T / expected-survival-rate
+clients (capped by the compiled slot count) instead of hoping.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from commefficient_tpu.telemetry.clients import ClientThroughputTracker
+
+
+class DeadlineDecision(NamedTuple):
+    """One round's deadline math (journal payload + work operand).
+
+    work:             [n] f32 fractions in (0, 1], or None when no one
+                      is truncated (round runs the work-free program)
+    deadline_s:       the wall-clock deadline, or None when unmeasured
+    est_round_s:      expected un-deadlined round seconds (max finite
+                      estimate — the round is as slow as its slowest
+                      measured participant), or None
+    expected_round_s: expected round seconds UNDER the deadline
+                      (max of min(estimate, deadline)), or None
+    """
+    work: Optional[np.ndarray]
+    deadline_s: Optional[float]
+    est_round_s: Optional[float]
+    expected_round_s: Optional[float]
+
+
+class DeadlinePolicy:
+    def __init__(self, tracker: ClientThroughputTracker,
+                 quantile: float, min_work: float = 0.1):
+        if not 0.0 < quantile <= 1.0:
+            raise ValueError(
+                f"deadline quantile={quantile} must be in (0, 1]")
+        if not 0.0 < min_work <= 1.0:
+            raise ValueError(
+                f"deadline min_work={min_work} must be in (0, 1] — "
+                "zero work is dropout, not a deadline truncation")
+        self.tracker = tracker
+        self.quantile = float(quantile)
+        self.min_work = float(min_work)
+
+    def decide(self, client_ids, num_examples) -> DeadlineDecision:
+        """Deadline + work fractions for one round's ACTIVE slots.
+        Cold-start-safe: with no measured participant there is no
+        deadline (DeadlineDecision of Nones) — never a NaN or a
+        zero-division (tracker estimate contract)."""
+        est = self.tracker.estimate_round_seconds(client_ids,
+                                                  num_examples)
+        finite = np.isfinite(est) & (est > 0)
+        if not finite.any():
+            return DeadlineDecision(None, None, None, None)
+        est_round_s = float(est[finite].max())
+        deadline_s = float(np.quantile(est[finite], self.quantile))
+        if deadline_s <= 0:
+            return DeadlineDecision(None, None, est_round_s, None)
+        over = finite & (est > deadline_s)
+        if not over.any():
+            # nobody truncated: the round runs exactly its estimates
+            return DeadlineDecision(None, deadline_s, est_round_s,
+                                    est_round_s)
+        work = np.ones(len(est), np.float32)
+        work[over] = np.clip(deadline_s / est[over], self.min_work,
+                             1.0).astype(np.float32)
+        # expected realized round time honors the min_work FLOOR: a
+        # floored straggler still runs min_work * est > deadline, so
+        # reporting the bare deadline would understate the journaled
+        # expectation exactly for the slowest clients
+        expected = float((est[finite] * work[finite]).max())
+        return DeadlineDecision(work, deadline_s, est_round_s, expected)
+
+
+def overprovision(target_survivors: int, num_slots: int,
+                  num_alive: int, survival_rate: float) -> int:
+    """How many participants to sample so EXPECTED survivors hit
+    `target_survivors`: ceil(target / survival_rate), clamped to
+    [target, min(num_slots, num_alive)]. target_survivors == 0 means
+    no target — fill every compiled slot (the pre-scheduler default).
+    """
+    if target_survivors <= 0:
+        return min(num_slots, num_alive)
+    s = min(max(float(survival_rate), 0.05), 1.0)
+    n = max(int(target_survivors), math.ceil(target_survivors / s))
+    return max(1, min(n, num_slots, num_alive))

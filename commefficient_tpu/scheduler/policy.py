@@ -1,0 +1,158 @@
+"""Participant-sampling policies: WHO joins each federated round.
+
+The reference hard-codes uniform cohort selection (reference:
+CommEfficient/data_utils/fed_sampler.py:55 `rng.choice`), and the
+original FL communication-efficiency work (PAPERS.md, 1610.05492)
+simply assumes *a* cohort selector exists. PR 4 built the measurement
+substrate — per-client EMA throughput in
+`telemetry.clients.ClientThroughputTracker` — and this module is the
+first consumer: a policy interface whose default is BIT-IDENTICAL to
+the hard-coded uniform draw, plus a throughput-aware policy that
+deprioritizes chronically slow clients while an exploration floor
+keeps every client measured.
+
+PRNG discipline (the dropout-vs-straggler rule of utils/faults):
+`ThroughputAwareSampler` draws from its OWN counter-based generator —
+`SeedSequence([seed, 0x5C4ED, round_idx])`, a domain tag distinct from
+the dropout (0x0D120) and straggler (0x51044) streams — so scheduling
+never perturbs fault draws, and a resumed run replays the identical
+selection for any round given the same tracker state (the tracker
+rides in checkpoints under `thr_*`). `UniformSampler` instead consumes
+the FedSampler's OWN `rng` with the exact call the pre-scheduler code
+made, which is what makes the default bit-identical: same generator,
+same method, same arguments, same stream position.
+
+Determinism caveat: throughput-aware selection is a pure function of
+(seed, round_idx, tracker state). Tracker RATES are wall-clock derived,
+so selection — like everything downstream of the tracker — informs
+SCHEDULING only, never the model update given a fixed participant set
+(the round engine stays pure in (state, seed, round)).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from commefficient_tpu.telemetry.clients import ClientThroughputTracker
+
+# counter-based PRNG domain tag for scheduler draws (distinct from
+# utils/faults: dropout 0x0D120, straggler 0x51044)
+SCHED_DOMAIN = 0x5C4ED
+
+SAMPLERS = ("uniform", "throughput")
+
+
+class ParticipantSampler:
+    """Interface: pick `num_slots` distinct participants for one round.
+
+    alive:     candidate GLOBAL client ids (non-exhausted this epoch)
+    num_slots: how many to draw (<= len(alive); the RoundScheduler's
+               over-provisioning decides this count)
+    rng:       the FedSampler's np.random.RandomState — the uniform
+               policy MUST draw from it (bit-identity contract);
+               policies with their own PRNG domain leave it untouched
+               so the data stream under them is still seed-replayable
+    round_idx: GLOBAL round index, the counter-based PRNG input
+    """
+
+    name = "?"
+
+    def select(self, alive: np.ndarray, num_slots: int, rng,
+               round_idx: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class UniformSampler(ParticipantSampler):
+    """The reference's uniform draw, verbatim: `rng.choice(alive,
+    num_slots, replace=False)` on the FedSampler's own RandomState.
+    With num_slots == num_workers (no over-provisioning) this is
+    byte-for-byte the call the pre-scheduler FedSampler made, so the
+    default configuration's data stream — and therefore every
+    ServerState bit — is identical to a build without the scheduler."""
+
+    name = "uniform"
+
+    def select(self, alive, num_slots, rng, round_idx):
+        return rng.choice(alive, num_slots, replace=False)
+
+
+class ThroughputAwareSampler(ParticipantSampler):
+    """Weighted draw favoring fast clients, with an exploration floor.
+
+    Selection probability per alive client:
+
+        p = (1 - explore_floor) * rate_c**speed_bias / sum(...)
+            + explore_floor / len(alive)
+
+    where rate_c is the tracker's EMA examples/sec and `speed_bias`
+    sharpens the deprioritization (1.0 = proportional-to-throughput;
+    the 2.0 default squares the ratio, because a round is as slow as
+    its SLOWEST member — one straggler in a cohort of W wastes W-1
+    clients' round, so the penalty for slowness must outrun the
+    linear odds of landing in a cohort). Unmeasured clients
+    (rate 0: never completed a round) take the MEDIAN measured rate —
+    a neutral prior, neither punished for being new nor favored over
+    measured-fast clients; when nothing is measured yet the draw is
+    uniform. The floor guarantees every alive client keeps a
+    participation probability of at least `explore_floor / len(alive)`
+    per slot drawn, so chronically slow clients keep getting measured
+    (their EMA can recover) instead of starving forever —
+    tests/test_scheduler.py checks the empirical distribution.
+
+    Draws come from a counter-based generator over (seed, SCHED_DOMAIN,
+    round_idx): stateless between rounds, so crash->resume replays the
+    identical choice for any round from checkpointed tracker state.
+    """
+
+    name = "throughput"
+
+    def __init__(self, seed: int, tracker: ClientThroughputTracker,
+                 explore_floor: float = 0.1, speed_bias: float = 2.0):
+        if not 0.0 <= explore_floor <= 1.0:
+            raise ValueError(
+                f"explore_floor={explore_floor} must be in [0, 1] "
+                "(1.0 degenerates to uniform)")
+        if speed_bias <= 0:
+            raise ValueError(
+                f"speed_bias={speed_bias} must be > 0 (1.0 = "
+                "throughput-proportional)")
+        self.seed = int(seed)
+        self.tracker = tracker
+        self.explore_floor = float(explore_floor)
+        self.speed_bias = float(speed_bias)
+
+    def weights(self, alive: np.ndarray) -> np.ndarray:
+        """Normalized selection probabilities over `alive` (exposed for
+        the fairness tests)."""
+        alive = np.asarray(alive, np.int64)
+        rates = self.tracker.examples_per_sec(alive).astype(np.float64)
+        measured = rates > 0
+        if measured.any():
+            rates = np.where(measured, rates,
+                             float(np.median(rates[measured])))
+            # normalize by the max before the bias exponent so the
+            # power never overflows, whatever the rate scale
+            w = (rates / rates.max()) ** self.speed_bias
+            p = w / w.sum()
+        else:
+            p = np.full(len(alive), 1.0 / len(alive))
+        f = self.explore_floor
+        p = (1.0 - f) * p + f / len(alive)
+        return p / p.sum()
+
+    def select(self, alive, num_slots, rng, round_idx):
+        alive = np.asarray(alive, np.int64)
+        gen = np.random.default_rng(np.random.SeedSequence(
+            [self.seed, SCHED_DOMAIN, int(round_idx)]))
+        return gen.choice(alive, size=int(num_slots), replace=False,
+                          p=self.weights(alive))
+
+
+def make_sampler(cfg, tracker: ClientThroughputTracker
+                 ) -> ParticipantSampler:
+    """Policy from `Config.sampler` (validated there)."""
+    if cfg.sampler == "uniform":
+        return UniformSampler()
+    if cfg.sampler == "throughput":
+        return ThroughputAwareSampler(cfg.seed, tracker,
+                                      explore_floor=cfg.explore_floor)
+    raise ValueError(f"unknown sampler {cfg.sampler!r}")

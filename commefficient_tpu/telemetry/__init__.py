@@ -142,6 +142,12 @@ class TelemetrySession:
         self._pending = None
         self._closed = False
         self._journal_warned = False
+        # cumulative communication byte totals (accounting.py feeds
+        # per-round sums through on_round/on_span; run_end carries the
+        # cumulative pair so a journal is self-contained on cost)
+        self._cum_down_bytes = 0.0
+        self._cum_up_bytes = 0.0
+        self._comm_seen = False
         _runtime.add_compile_listener(self._on_compile)
 
     # ---------------- journal passthrough --------------------------------
@@ -204,22 +210,38 @@ class TelemetrySession:
 
     # ---------------- per-round path (FedModel.__call__) -----------------
     def on_round(self, round_idx: int, client_ids, telemetry_vec,
-                 num_examples) -> None:
+                 num_examples, comm=None, scheduled=None) -> None:
         """Buffer one round's device metrics; materialize + journal the
-        PREVIOUS round (one-round lag, so no per-round host sync)."""
+        PREVIOUS round (one-round lag, so no per-round host sync).
+        comm: optional (download_bytes, upload_bytes) round totals from
+        the accountant — journaled on the round event and accumulated
+        into the run_end cumulative pair. scheduled: optional [W]
+        mask; zero slots are idle scheduler pads, excluded from the
+        throughput tracker (telemetry/clients.update_round)."""
         now = self._clock()
         prev, self._pending = self._pending, (
             int(round_idx), np.asarray(client_ids), telemetry_vec,
-            num_examples, now)
+            num_examples, now, comm, scheduled)
         if prev is not None:
             self._emit_round(prev, now - prev[4])
 
+    def _record_comm(self, fields: dict, comm) -> None:
+        if comm is None:
+            return
+        down, up = float(comm[0]), float(comm[1])
+        self._cum_down_bytes += down
+        self._cum_up_bytes += up
+        self._comm_seen = True
+        fields["down_bytes"] = down
+        fields["up_bytes"] = up
+
     def _emit_round(self, rec, seconds: Optional[float]) -> None:
-        round_idx, ids, vec, counts, _ = rec
+        round_idx, ids, vec, counts, _, comm, scheduled = rec
         counts_h = np.asarray(self._materialize(counts))
         if (self.tracker is not None and seconds is not None
                 and seconds > 0):
-            self.tracker.update_round(ids, counts_h, seconds)
+            self.tracker.update_round(ids, counts_h, seconds,
+                                      scheduled=scheduled)
         if self.journal is not None:
             fields = {"round": round_idx}
             named = tmetrics.named(
@@ -229,7 +251,10 @@ class TelemetrySession:
                 fields["metrics"] = named
             if seconds is not None:
                 fields["seconds"] = round(seconds, 6)
+            self._record_comm(fields, comm)
             self.journal_event("round", **fields)
+        elif comm is not None:
+            self._record_comm({}, comm)
 
     def flush(self) -> None:
         """Drain the one-round-lag buffer (end of epoch/run; before a
@@ -244,12 +269,17 @@ class TelemetrySession:
     def on_span(self, first_round: int, ids_rows: np.ndarray,
                 telemetry_rows: Optional[np.ndarray],
                 counts_rows: np.ndarray,
-                dispatch_s: float, block_s: float) -> None:
+                dispatch_s: float, block_s: float,
+                comm_rows=None, scheduled_rows=None) -> None:
         """Consume one completed scanned span: host-materialized
         [N, W] ids/counts and [N, M] metric rows (the caller did the
         explicit span-boundary device_get). Journals one `span` event
         plus one `round` event per round, and feeds the tracker with
-        the span-amortized per-round wall time."""
+        the span-amortized per-round wall time. comm_rows: optional
+        per-round (download_bytes, upload_bytes) totals (None entries
+        for unaccounted rounds — FedModel.run_rounds(account=False)).
+        scheduled_rows: optional per-round [W] masks whose zero slots
+        are idle scheduler pads, excluded from the tracker."""
         # a pending per-round buffer holds an EARLIER round (mixed
         # per-round + scanned usage): drain it first so the journal's
         # round events stay strictly ordered
@@ -259,7 +289,9 @@ class TelemetrySession:
         if self.tracker is not None:
             for i in range(n):
                 self.tracker.update_round(
-                    ids_rows[i], counts_rows[i], per_round_s)
+                    ids_rows[i], counts_rows[i], per_round_s,
+                    scheduled=(None if scheduled_rows is None
+                               else scheduled_rows[i]))
         if self.journal is not None:
             batch = [("span", {"first_round": int(first_round),
                                "rounds": n,
@@ -273,9 +305,14 @@ class TelemetrySession:
                         np.asarray(telemetry_rows[i], np.float32))
                     if named:
                         fields["metrics"] = named
+                if comm_rows is not None:
+                    self._record_comm(fields, comm_rows[i])
                 batch.append(("round", fields))
             # one append + fsync for the whole span's records
             self._safe_write(lambda: self.journal.events(batch))
+        elif comm_rows is not None:
+            for comm in comm_rows:
+                self._record_comm({}, comm)
 
     # ---------------- profiler capture (--profile_spans) -----------------
     def span_profile_begin(self, span_idx: int) -> None:
@@ -317,5 +354,12 @@ class TelemetrySession:
                                dir=self._profile_dir)
         _runtime.remove_compile_listener(self._on_compile)
         if self.journal is not None:
+            if self._comm_seen:
+                # cumulative accountant totals: the journal is
+                # self-contained on communication cost (validated
+                # against the per-round sums by validate_journal)
+                fields.setdefault("down_bytes_total",
+                                  self._cum_down_bytes)
+                fields.setdefault("up_bytes_total", self._cum_up_bytes)
             self.journal_event("run_end", **fields)
             self.journal.close()

@@ -63,7 +63,8 @@ class ClientThroughputTracker:
         self.busy_seconds = np.zeros(self.num_clients, np.float64)
 
     def update_round(self, client_ids, num_examples, round_seconds,
-                     survivors: Optional[np.ndarray] = None) -> None:
+                     survivors: Optional[np.ndarray] = None,
+                     scheduled: Optional[np.ndarray] = None) -> None:
         """Fold one round's measurements in.
 
         client_ids:    [W] global ids sampled into the round (assumed
@@ -77,13 +78,27 @@ class ClientThroughputTracker:
                        skips the update (no timing signal)
         survivors:     optional [W] mask; zeroes num_examples for
                        callers whose counts don't already encode drops
+        scheduled:     optional [W] mask; slots at 0 were IDLE pads
+                       (scheduler over-provisioning below the compiled
+                       width) and are EXCLUDED entirely — unlike a
+                       dropped client, an idle pad was never asked to
+                       work, so counting it as a participation would
+                       depress the completion ratio the scheduler's
+                       survival estimate reads (a self-reinforcing
+                       over-provisioning error)
         """
         if round_seconds is None or not round_seconds > 0:
             return
         ids = np.asarray(client_ids, np.int64).reshape(-1)
         ex = np.asarray(num_examples, np.float64).reshape(-1)
+        if scheduled is not None:
+            keep = np.asarray(scheduled).reshape(-1) > 0
+            ids, ex = ids[keep], ex[keep]
         if survivors is not None:
-            ex = ex * (np.asarray(survivors).reshape(-1) > 0)
+            surv = np.asarray(survivors).reshape(-1)
+            if scheduled is not None:
+                surv = surv[keep]
+            ex = ex * (surv > 0)
         self.participations[ids] += 1
         done = ex > 0
         done_ids = ids[done]
@@ -108,17 +123,41 @@ class ClientThroughputTracker:
             return self.rate.copy()
         return self.rate[np.asarray(client_ids, np.int64)].copy()
 
-    def estimate_round_seconds(self, client_ids,
-                               num_examples) -> np.ndarray:
+    def estimate_round_seconds(self, client_ids, num_examples,
+                               cold_start_seconds: Optional[float]
+                               = None) -> np.ndarray:
         """Expected seconds for each client to process its batch at its
-        measured EMA rate — the deadline-estimation primitive. Clients
-        with no completed round yet estimate to +inf so callers fall
-        back to a prior instead of treating them as infinitely fast."""
+        measured EMA rate — the deadline-estimation primitive.
+
+        Cold-start contract (never NaN, never a zero-division):
+
+          * zero examples estimate 0.0 seconds regardless of
+            measurement state (no work takes no time);
+          * an UNMEASURED client (no completed round yet) estimates
+            +inf by default, so callers fall back to a prior instead
+            of treating it as infinitely fast (the DeadlinePolicy's
+            fallback is "never truncate the unmeasured");
+          * with `cold_start_seconds` set, unmeasured clients instead
+            get a CONSERVATIVE finite estimate: their batch at the
+            SLOWEST measured rate in the population (a new client is
+            assumed no faster than the slowest known one), or
+            `cold_start_seconds` itself when nothing at all has been
+            measured yet.
+        """
         ids = np.asarray(client_ids, np.int64)
         ex = np.asarray(num_examples, np.float64)
         r = self.rate[ids].astype(np.float64)
         with np.errstate(divide="ignore"):
-            return np.where(r > 0, ex / np.maximum(r, 1e-30), np.inf)
+            out = np.where(r > 0, ex / np.maximum(r, 1e-30), np.inf)
+        out = np.where(ex <= 0, 0.0, out)
+        unmeasured = (r <= 0) & (ex > 0)
+        if unmeasured.any() and cold_start_seconds is not None:
+            measured = self.rate[self.rate > 0]
+            if measured.size:
+                out[unmeasured] = ex[unmeasured] / float(measured.min())
+            else:
+                out[unmeasured] = float(cold_start_seconds)
+        return out
 
     # -- checkpoint round-trip (bit-exact) --------------------------------
     def state_dict(self) -> dict:

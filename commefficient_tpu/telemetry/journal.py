@@ -21,10 +21,19 @@ multi-controller run writes (drivers construct the journal behind
 Known event kinds written by the framework (all optional-fielded;
 consumers must tolerate kinds they don't know):
 
-  run_start / run_end     driver lifecycle, config snapshot / ok flag
+  run_start / run_end     driver lifecycle, config snapshot / ok flag;
+                          run_end also carries cumulative
+                          down_bytes_total / up_bytes_total when the
+                          accountant fed the session
   round                   one federated round: `round` index, optional
                           `metrics` dict named per telemetry.metrics.
-                          METRIC_NAMES, optional `seconds`
+                          METRIC_NAMES, optional `seconds`, optional
+                          down_bytes / up_bytes accountant totals
+  schedule                one round's scheduler decision
+                          (commefficient_tpu/scheduler): sampler,
+                          n_sampled, optional deadline_s /
+                          est_round_s / expected_round_s /
+                          truncated_slots
   span                    one scanned span: first_round, rounds,
                           dispatch_s (host staging + dispatch),
                           block_s (device completion wait)
@@ -193,7 +202,15 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
         round index WITHIN one run segment (a duplicate means two code
         paths journaled the same round);
       * `round` indices are strictly increasing within a segment;
-      * `metrics` payloads (when present) are {str: number} dicts.
+      * `metrics` payloads (when present) are {str: number} dicts;
+      * `down_bytes`/`up_bytes` (when present) are non-negative
+        numbers, and a segment's `run_end` cumulative
+        down_bytes_total/up_bytes_total covers at least the sum of its
+        journaled per-round totals (accounting.py's per-round and
+        cumulative views must agree);
+      * `schedule` events carry an integer `round` and a `sampler`
+        name; their optional deadline_s/est_round_s payloads are
+        non-negative numbers.
 
     A `run_start` event opens a new run SEGMENT and resets the round
     tracking: a preempted run resumed with the same --journal_path
@@ -206,10 +223,25 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
     records, problems = read_journal(path)
     seen_rounds = set()
     last_round = None
+    seg_down = seg_up = 0.0
+
+    def _comm_field(rec, n, field):
+        """Validate one byte-total field; returns its value or None."""
+        v = rec.get(field)
+        if v is None:
+            return None
+        if not isinstance(v, (int, float)) or v < 0:
+            problems.append(
+                f"record {n}: `{field}` must be a non-negative "
+                f"number (got {v!r})")
+            return None
+        return float(v)
+
     for n, rec in enumerate(records, 1):
         if rec.get("event") == "run_start":
             seen_rounds = set()
             last_round = None
+            seg_down = seg_up = 0.0
         for field in REQUIRED_FIELDS:
             if field not in rec:
                 problems.append(f"record {n}: missing `{field}`")
@@ -219,7 +251,36 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
                 f"record {n}: schema version {v!r} != {SCHEMA_VERSION}")
         if not isinstance(rec.get("ts", 0.0), (int, float)):
             problems.append(f"record {n}: non-numeric `ts`")
+        if rec.get("event") == "schedule":
+            if not isinstance(rec.get("round"), int):
+                problems.append(
+                    f"record {n}: schedule event without an integer "
+                    f"`round` (got {rec.get('round')!r})")
+            if not isinstance(rec.get("sampler"), str):
+                problems.append(
+                    f"record {n}: schedule event without a `sampler` "
+                    "name")
+            for field in ("deadline_s", "est_round_s",
+                          "expected_round_s"):
+                _comm_field(rec, n, field)
+        if rec.get("event") == "run_end":
+            total_down = _comm_field(rec, n, "down_bytes_total")
+            total_up = _comm_field(rec, n, "up_bytes_total")
+            # cumulative totals must cover the segment's journaled
+            # per-round sums (0.5-byte slack for float accumulation)
+            if total_down is not None and total_down < seg_down - 0.5:
+                problems.append(
+                    f"record {n}: down_bytes_total {total_down} < "
+                    f"sum of per-round down_bytes {seg_down}")
+            if total_up is not None and total_up < seg_up - 0.5:
+                problems.append(
+                    f"record {n}: up_bytes_total {total_up} < "
+                    f"sum of per-round up_bytes {seg_up}")
         if rec.get("event") == "round":
+            d = _comm_field(rec, n, "down_bytes")
+            u = _comm_field(rec, n, "up_bytes")
+            seg_down += d or 0.0
+            seg_up += u or 0.0
             r = rec.get("round")
             if not isinstance(r, int):
                 problems.append(f"record {n}: round event without an "
@@ -257,16 +318,24 @@ def summarize(records: List[dict]) -> dict:
     kinds: dict = {}
     rounds = []
     span_s = ckpt_s = 0.0
+    down_b = up_b = 0.0
+    deadlines = 0
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
         if kind == "round" and isinstance(rec.get("round"), int):
             rounds.append(rec["round"])
+            if isinstance(rec.get("down_bytes"), (int, float)):
+                down_b += float(rec["down_bytes"])
+            if isinstance(rec.get("up_bytes"), (int, float)):
+                up_b += float(rec["up_bytes"])
         elif kind == "span":
             span_s += float(rec.get("dispatch_s", 0.0))
             span_s += float(rec.get("block_s", 0.0))
         elif kind == "checkpoint":
             ckpt_s += float(rec.get("seconds", 0.0))
+        elif kind == "schedule" and rec.get("deadline_s") is not None:
+            deadlines += 1
     return {
         "records": len(records),
         "events": dict(sorted(kinds.items())),
@@ -275,4 +344,7 @@ def summarize(records: List[dict]) -> dict:
         "last_round": max(rounds) if rounds else None,
         "span_seconds": round(span_s, 3),
         "checkpoint_seconds": round(ckpt_s, 3),
+        "down_mib": round(down_b / (1024 ** 2), 3),
+        "up_mib": round(up_b / (1024 ** 2), 3),
+        "deadline_rounds": deadlines,
     }

@@ -177,6 +177,12 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 os.path.join(log_dir or ".", "profile"))
             profiling = profiled = True
         epoch_rounds = min(spe, total_rounds - rounds_done)
+        if model.scheduler is not None:
+            # sync the scheduler's round counter to the stream about
+            # to be drawn: the resumed first epoch replays (and
+            # re-selects, without dispatching) its skipped head, so
+            # the counter starts at the EPOCH's first round
+            model.scheduler.begin_epoch(rounds_done - skip_rounds)
         epoch_stream = train_loader.epoch(skip=skip_rounds)
         skip_rounds = 0
         losses, accs = [], []
@@ -350,7 +356,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 accountant=model.accountant,
                 prev_change_words=model._prev_change_words,
                 fingerprint=model.checkpoint_fingerprint,
-                throughput=model.throughput.state_dict())
+                throughput=model.throughput.state_dict(),
+                scheduler=model.scheduler_state())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
                     "checkpoint", path=path,
@@ -461,6 +468,15 @@ def main(argv=None) -> bool:
                      lr_scale_vec=lr_scale_vec)
     opt = FedOptimizer(model)
 
+    # round scheduler (commefficient_tpu/scheduler): policy-driven
+    # participant sampling + deadline-driven rounds over the model's
+    # own throughput tracker. Attached BEFORE --resume so a
+    # checkpoint's sched_* counters restore into this instance; the
+    # uniform/no-deadline default is bit-identical to a scheduler-free
+    # build.
+    from commefficient_tpu.scheduler import attach_round_scheduler
+    attach_round_scheduler(model, train_loader)
+
     if mh.is_multihost():
         # per-process batch feeding — or, on non-contiguous layouts,
         # the globalize() fallback (one shared implementation:
@@ -521,7 +537,8 @@ def main(argv=None) -> bool:
                 accountant=model.accountant,
                 prev_change_words=model._prev_change_words,
                 fingerprint=model.checkpoint_fingerprint,
-                throughput=model.throughput.state_dict())
+                throughput=model.throughput.state_dict(),
+                scheduler=model.scheduler_state())
             if coord:
                 print(f"saved checkpoint to {path}")
     finally:

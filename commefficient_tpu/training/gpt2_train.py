@@ -213,6 +213,10 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
         pending = None
         aborted = False
+        if model.scheduler is not None:
+            # sync the scheduler's round counter to the epoch stream
+            # (resume replays the skipped head — same as cv_train)
+            model.scheduler.begin_epoch(batch_idx - skip_rounds)
         # sampler-level skip: the skipped rounds advance index math
         # only, never materializing batch data (O(skip) host work was
         # O(skip × batch fetch+transform) before)
@@ -312,7 +316,8 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 accountant=model.accountant,
                 prev_change_words=model._prev_change_words,
                 fingerprint=model.checkpoint_fingerprint,
-                throughput=model.throughput.state_dict())
+                throughput=model.throughput.state_dict(),
+                scheduler=model.scheduler_state())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
                     "checkpoint", path=written,
@@ -481,6 +486,12 @@ def main(argv=None) -> bool:
                      num_clients=train_loader.dataset.num_clients)
     opt = FedOptimizer(model)
 
+    # round scheduler, attached BEFORE --resume so sched_* checkpoint
+    # counters restore into this instance (wiring shared with
+    # cv_train; uniform/no-deadline default is bit-identical)
+    from commefficient_tpu.scheduler import attach_round_scheduler
+    attach_round_scheduler(model, train_loader)
+
     coord = mh.is_coordinator()
     if mh.is_multihost():
         # per-process batch feeding — or, on non-contiguous layouts,
@@ -547,7 +558,8 @@ def main(argv=None) -> bool:
                            accountant=model.accountant,
                            prev_change_words=model._prev_change_words,
                            fingerprint=model.checkpoint_fingerprint,
-                           throughput=model.throughput.state_dict())
+                           throughput=model.throughput.state_dict(),
+                           scheduler=model.scheduler_state())
             # HF-style final artifact: tokenizer + config + weights
             # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
             if coord:

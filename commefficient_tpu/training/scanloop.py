@@ -142,7 +142,8 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
             accountant=model.accountant,
             prev_change_words=model._prev_change_words,
             fingerprint=model.checkpoint_fingerprint,
-            throughput=model.throughput.state_dict())
+            throughput=model.throughput.state_dict(),
+            scheduler=model.scheduler_state())
         tele = getattr(model, "telemetry", None)
         if tele is not None:
             # the save is a full state gather + disk write — exactly

@@ -87,9 +87,11 @@ def validate_fingerprint(found: dict, expected: dict,
 
 class Checkpoint(NamedTuple):
     """Loaded training state; accounting state rides along so resumed
-    runs keep cumulative comm totals correct, and the per-client
+    runs keep cumulative comm totals correct, the per-client
     throughput-tracker state (telemetry/clients.py) so measured
-    client speeds survive preemption bit-exactly."""
+    client speeds survive preemption bit-exactly, and the round
+    scheduler's counters (commefficient_tpu/scheduler, `sched_*`
+    keys) for the same reason."""
     server: ServerState
     clients: Optional[ClientState]
     scheduler_step: int
@@ -97,6 +99,7 @@ class Checkpoint(NamedTuple):
     prev_change_words: Optional[np.ndarray] = None
     fingerprint: Optional[dict] = None
     throughput: Optional[dict] = None
+    scheduler: Optional[dict] = None
 
 
 def save_checkpoint(path: str, server: ServerState,
@@ -107,7 +110,8 @@ def save_checkpoint(path: str, server: ServerState,
                     prev_change_words: Optional[np.ndarray] = None,
                     chunk_rows: int = 256,
                     fingerprint: Optional[dict] = None,
-                    throughput: Optional[dict] = None) -> str:
+                    throughput: Optional[dict] = None,
+                    scheduler: Optional[dict] = None) -> str:
     """Write training state to `path` (.npz appended if absent).
     Per-client state can be excluded (include_clients=False) to keep
     files small when clients are stateless (error_type != local and
@@ -153,6 +157,11 @@ def save_checkpoint(path: str, server: ServerState,
         # state_dict()); plain arrays, so the resume is bit-exact
         for k, v in throughput.items():
             arrays[f"thr_{k}"] = np.asarray(v)
+    if scheduler is not None:
+        # round-scheduler counters (scheduler.RoundScheduler
+        # state_dict()); same bit-exact-resume contract as thr_*
+        for k, v in scheduler.items():
+            arrays[f"sched_{k}"] = np.asarray(v)
     if fingerprint is not None:
         for k in FINGERPRINT_FIELDS:
             arrays[f"fp_{k}"] = np.asarray(str(fingerprint[k]))
@@ -235,8 +244,11 @@ def load_checkpoint(path: str,
             if "acct_prev_change_words" in z.files else None)
     thr = {k[len("thr_"):]: z[k] for k in z.files
            if k.startswith("thr_")}
+    sched = {k[len("sched_"):]: z[k] for k in z.files
+             if k.startswith("sched_")}
     return Checkpoint(server, clients, int(z["scheduler_step"]),
-                      acct or None, prev, fingerprint, thr or None)
+                      acct or None, prev, fingerprint, thr or None,
+                      sched or None)
 
 
 # ---------------- keep-last-k rotation + latest manifest -----------------

@@ -7,6 +7,15 @@ this tool over the result — a malformed line, a wrong schema version,
 or a duplicate/out-of-order round event fails the build, so the record
 format every perf investigation depends on cannot silently rot.
 
+ISSUE 5 extended the checked surface: per-round accountant byte
+totals (`down_bytes`/`up_bytes` on round events) must be non-negative
+numbers whose `run_end` cumulative covers the per-round sums, and
+`schedule` events (the round scheduler's decisions) must carry an
+integer round + sampler name with non-negative deadline/estimate
+payloads. tier1.sh runs a SECOND smoke under `--sampler throughput
+--deadline_quantile 0.9` so those records are exercised in CI; the
+summary line includes down_mib/up_mib and the deadline-round count.
+
 Usage:
     python scripts/journal_summary.py <journal.jsonl> [--quiet]
 

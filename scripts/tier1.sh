@@ -38,5 +38,24 @@ if [ "$rc" -eq 0 ]; then
       || { echo "TELEMETRY_SMOKE_FAILED"; exit 1; }
   python scripts/journal_summary.py "$JR" \
       || { echo "JOURNAL_INVALID"; exit 1; }
+
+  # scheduled-driver smoke (ISSUE 5 satellite): the same tiny scanned
+  # run under throughput-aware sampling + a 0.9-quantile deadline; its
+  # journal (schedule events, per-round byte totals) must pass the
+  # same invariant check, so the scheduler's record format cannot rot.
+  JR2=/tmp/_t1_journal_sched.jsonl
+  rm -f "$JR2"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode uncompressed \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --scan_rounds --scan_span 1 --debug_transfer_guard \
+      --sampler throughput --deadline_quantile 0.9 \
+      --journal_path "$JR2" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "SCHEDULED_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR2" \
+      || { echo "SCHED_JOURNAL_INVALID"; exit 1; }
 fi
 exit $rc

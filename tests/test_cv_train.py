@@ -93,6 +93,36 @@ def test_smoke_dropout_rotating_checkpoint_resume(tmp_path):
                     "--checkpoint_path", ck, "--num_epochs", "0.1")
 
 
+def test_smoke_scheduled_throughput_deadline(tmp_path):
+    """ISSUE 5: the scheduled driver end to end — throughput-aware
+    sampling + a 0.9-quantile deadline + over-provisioning over the
+    scanned path with the steady-state transfer guard armed. The run
+    journal validates, carries the scheduler's `schedule` events, and
+    every round event carries its accountant byte totals."""
+    from commefficient_tpu.telemetry.journal import validate_journal
+
+    jr = str(tmp_path / "sched_journal.jsonl")
+    assert run_main(tmp_path, "--mode", "uncompressed",
+                    "--scan_rounds", "--scan_span", "1",
+                    "--debug_transfer_guard", "--num_epochs", "0.1",
+                    "--sampler", "throughput",
+                    "--deadline_quantile", "0.9",
+                    "--target_survivors", "6",
+                    "--journal_path", jr)
+    records, problems = validate_journal(jr)
+    assert not problems, problems
+    sched = [r for r in records if r["event"] == "schedule"]
+    assert sched, "no scheduler decisions journaled"
+    assert all(r["sampler"] == "throughput" for r in sched)
+    # over-provisioning: target 6 with nothing dropping -> 6 of the 8
+    # compiled slots active
+    assert all(r["n_sampled"] == 6 for r in sched)
+    rounds = [r for r in records if r["event"] == "round"]
+    assert rounds and all("up_bytes" in r for r in rounds)
+    assert records[-1]["event"] == "run_end"
+    assert records[-1]["up_bytes_total"] > 0
+
+
 def test_smoke_scan_transfer_guard_and_journal(tmp_path):
     """ISSUE 4 satellites: --debug_transfer_guard arms
     forbid_transfers over every steady-state span (--scan_span 1 makes

@@ -145,6 +145,33 @@ GL006_NEG = """
             return f.read()
 """
 
+GL007_POS = """
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    def build(f, mesh, P):
+        mapped = shard_map(f, mesh=mesh, in_specs=(P("clients"),))
+        jitted = jax.experimental.pjit.pjit(f)
+        return mapped, jitted
+"""
+GL007_NEG = """
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    def build(f, mesh, P, specs, **extra):
+        mapped = shard_map(f, mesh=mesh, in_specs=(P("clients"),),
+                           out_specs=P("clients"))
+        jitted = jax.experimental.pjit.pjit(
+            f, out_shardings=specs)
+        # **kwargs forwarding may carry the spec — precision over
+        # recall, stay quiet
+        fwd = shard_map(f, mesh=mesh, **extra)
+        # legal POSITIONAL forms pin the out-spec slot too
+        pos = shard_map(f, mesh, (P("clients"),), P("clients"))
+        pos_jit = jax.experimental.pjit.pjit(f, specs, specs)
+        return mapped, jitted, fwd, pos, pos_jit
+"""
+
 FIXTURES = {
     "GL001": (GL001_POS, GL001_NEG),
     "GL002": (GL002_POS, GL002_NEG),
@@ -152,6 +179,7 @@ FIXTURES = {
     "GL004": (GL004_POS, GL004_NEG),
     "GL005": (GL005_POS, GL005_NEG),
     "GL006": (GL006_POS, GL006_NEG),
+    "GL007": (GL007_POS, GL007_NEG),
 }
 
 

@@ -1,0 +1,664 @@
+"""Round scheduler tests (ISSUE 5): the telemetry-to-control loop.
+
+Acceptance coverage:
+
+  * DEFAULT IS IDENTITY — a uniform/no-deadline scheduler draws the
+    byte-identical participant stream the pre-scheduler FedSampler
+    drew, and ServerState trajectories through FedModel are
+    bit-identical to a scheduler-free build for sketch / true_topk /
+    fedavg;
+  * the scheduler adds NO device programs (idle slots ride the
+    dropout program, deadlines ride the straggler program) and a
+    scheduled scanned span is transfer-guard clean;
+  * FAIRNESS — ThroughputAwareSampler's empirical participation
+    respects the exploration floor, and its uniform mode is exactly
+    UniformSampler;
+  * ADAPTATION — under a scripted FaultSchedule.slow profile the
+    tracker measures the slow clients end to end (through the jitted
+    round's processed-example counts) and ThroughputAwareSampler +
+    DeadlinePolicy measurably reduce estimated round time vs uniform
+    sampling, asserted via the journaled `schedule` events;
+  * RESUME — crash -> resume of a scheduled run is bit-exact,
+    including scheduler counters and tracker state.
+"""
+import itertools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.scheduler import (
+    DeadlinePolicy, RoundScheduler, ThroughputAwareSampler,
+    UniformSampler, overprovision,
+)
+from commefficient_tpu.data.sampler import FedSampler
+from commefficient_tpu.telemetry import RunJournal, TelemetrySession
+from commefficient_tpu.telemetry.clients import ClientThroughputTracker
+from commefficient_tpu.telemetry.journal import validate_journal
+from commefficient_tpu.utils.faults import FaultSchedule, InjectedFault
+
+D = 8
+W = 8
+B = 4
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+def _cfg(**kw):
+    base = dict(mode="uncompressed", grad_size=D, weight_decay=0.0,
+                num_workers=W, local_momentum=0.0, virtual_momentum=0.9,
+                error_type="none", microbatch_size=-1, num_clients=W)
+    base.update(kw)
+    return Config(**base).validate()
+
+
+def _fed_model(cfg):
+    model = FedModel(None, loss_fn, cfg, params={"w": jnp.zeros(D)})
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+def _client_pool(num_clients, seed=0):
+    """Fixed per-client data: client c's batch is always the same
+    [B, D] block, so a round's operands are a pure function of its
+    participant slots (the determinism the resume test needs)."""
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(D).astype(np.float32)
+    x = rng.randn(num_clients, B, D).astype(np.float32)
+    y = np.einsum("cbd,d->cb", x, w_true).astype(np.float32)
+    return x, y
+
+
+def _batch_for(slot_ids, pool, active=None):
+    x, y = pool
+    ids = np.asarray(slot_ids)
+    mask = np.ones((len(ids), B), np.float32)
+    if active is not None:
+        mask *= np.asarray(active)[:, None]
+    return (ids.astype(np.int32), (x[ids], y[ids]), mask)
+
+
+def _schedule_round(sched, num_clients, rng):
+    """The FedSampler's selection/pad/commit dance for model-level
+    tests that feed batches directly (data/sampler.py keeps the real
+    implementation; the pad rule — distinct UNCHOSEN ids, zero mask —
+    must match it)."""
+    chosen = np.asarray(sched.select(np.arange(num_clients), W, rng))
+    if len(chosen) < W:
+        pad = np.setdiff1d(np.arange(num_clients),
+                           chosen)[:W - len(chosen)]
+        slot_ids = np.concatenate([chosen, pad])
+    else:
+        slot_ids = chosen
+    active = (np.arange(W) < len(chosen)).astype(np.float32)
+    sched.commit_round(slot_ids, active * B)
+    return slot_ids, active
+
+
+# ---------------- default-is-identity ---------------------------------------
+
+MODE_CFGS = {
+    "sketch": dict(mode="sketch", error_type="virtual", k=4,
+                   num_rows=2, num_cols=32, num_blocks=1),
+    "true_topk": dict(mode="true_topk", error_type="virtual", k=4),
+    "fedavg": dict(mode="fedavg", local_batch_size=-1,
+                   virtual_momentum=0.0),
+}
+
+
+@pytest.mark.parametrize("mode", sorted(MODE_CFGS))
+def test_default_scheduler_bit_identical_server_state(mode):
+    """A uniform/no-deadline RoundScheduler attached to the model (and
+    consulted for every selection) leaves the ServerState trajectory
+    BIT-identical to a scheduler-free build — the pre-PR behavior."""
+    pool = _client_pool(W)
+    finals = []
+    for with_sched in (False, True):
+        cfg = _cfg(**MODE_CFGS[mode])
+        model, _ = _fed_model(cfg)
+        rng = np.random.RandomState(5)
+        sched = None
+        if with_sched:
+            sched = RoundScheduler(cfg, W, model.throughput)
+            model.attach_scheduler(sched)
+            assert sched.is_default
+            sched.begin_epoch(0)
+        for _ in range(4):
+            if sched is not None:
+                slot_ids, _ = _schedule_round(sched, W, rng)
+            else:
+                slot_ids = rng.choice(np.arange(W), W, replace=False)
+            model(_batch_for(slot_ids, pool))
+        finals.append(model.server)
+    a, b = finals
+    for field in ("ps_weights", "Vvelocity", "Verror"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)),
+            np.asarray(getattr(b, field)), err_msg=f"{mode}: {field}")
+    assert int(a.round_idx) == int(b.round_idx) == 4
+
+
+def test_uniform_scheduler_stream_bit_identical():
+    """FedSampler with a default scheduler yields the byte-identical
+    RoundIndices stream (ids, local indices, masks) a scheduler-free
+    FedSampler yields — same RandomState, same calls, same order."""
+    dpc = np.full(16, 10)
+    plain = FedSampler(dpc, 4, 3, seed=7)
+    wired = FedSampler(dpc, 4, 3, seed=7)
+    wired.scheduler = RoundScheduler(
+        _cfg(num_workers=4, num_clients=16), 16,
+        ClientThroughputTracker(16))
+    wired.scheduler.begin_epoch(0)
+    sa, sb = list(plain.epoch()), list(wired.epoch())
+    assert len(sa) == len(sb) and len(sa) > 0
+    for ra, rb in zip(sa, sb):
+        np.testing.assert_array_equal(ra.client_ids, rb.client_ids)
+        np.testing.assert_array_equal(ra.idx_within, rb.idx_within)
+        np.testing.assert_array_equal(ra.mask, rb.mask)
+
+
+def test_scheduler_adds_no_device_programs(sanitize):
+    """Scheduling decisions ride the EXISTING fault operands: after
+    the mask-free warmup round (which also compiles FedModel's
+    accounting helpers), an idle-slot (over-provisioned) round and a
+    deadline-truncated round compile EXACTLY the two standing fault
+    programs — dropout and dropout+stragglers — and a second sweep is
+    all cache hits. Scheduling never traces a fourth round program."""
+    cfg = _cfg(sampler="throughput", deadline_quantile=0.9,
+               target_survivors=2, num_clients=12)
+    model, _ = _fed_model(cfg)
+    sched = RoundScheduler(cfg, 12, model.throughput)
+    model.attach_scheduler(sched)
+    pool = _client_pool(12)
+    rng = np.random.RandomState(0)
+
+    def drive(round_idx):
+        sched.begin_epoch(round_idx)
+        slot_ids, active = _schedule_round(sched, 12, rng)
+        model(_batch_for(slot_ids, pool, active))
+        return active
+
+    # warmup: a plan-free round compiles the MASK-FREE program plus
+    # the accounting helpers (pack_change_bits + the eager ps-delta)
+    dflt = RoundScheduler(_cfg(num_clients=12), 12, model.throughput)
+    model.attach_scheduler(dflt)
+    dflt.begin_epoch(0)
+    slot_ids, _ = _schedule_round(dflt, 12, rng)
+    model(_batch_for(slot_ids, pool))
+    model.attach_scheduler(sched)
+
+    with sanitize.assert_program_count(2):
+        for sweep in range(2):
+            # (a) no measurements yet -> no deadline, idle slots only
+            # (target 2 of 8 slots) -> the DROPOUT program
+            model.throughput.rate[:] = 0.0
+            active = drive(1 + 10 * sweep)
+            assert active.sum() == 2
+            # (b) measured with distinct rates -> any cohort's 0.9-
+            # quantile deadline truncates its slowest member -> the
+            # DROPOUT+STRAGGLER (work) program
+            model.throughput.rate[:] = np.linspace(
+                2.0, 8.0, 12).astype(np.float32)
+            drive(2 + 10 * sweep)
+            assert sched.truncated_slots > 0
+
+
+def test_scheduled_scanned_span_transfer_guard_clean(sanitize):
+    """A steady-state scanned span carrying scheduler plans (idle
+    slots + deadline fractions) performs zero implicit transfers: the
+    plan arrays enter through the same explicit globalize the fault
+    operands use."""
+    cfg = _cfg(sampler="throughput", deadline_quantile=0.8,
+               target_survivors=4, num_clients=12)
+    model, _ = _fed_model(cfg)
+    sched = RoundScheduler(cfg, 12, model.throughput)
+    model.attach_scheduler(sched)
+    rates = np.full(12, 8.0, np.float32)
+    rates[:3] = 0.5
+    model.throughput.rate[:] = rates
+    model.throughput.completions[:] = 3
+    model.throughput.participations[:] = 3
+    pool = _client_pool(12)
+    rng = np.random.RandomState(1)
+
+    def span(first_round, n):
+        sched.begin_epoch(first_round)
+        rounds = [_schedule_round(sched, 12, rng) for _ in range(n)]
+        ids = np.stack([r[0] for r in rounds])
+        act = np.stack([r[1] for r in rounds])
+        x, y = pool
+        mask = np.ones((n, W, B), np.float32) * act[:, :, None]
+        return (ids.astype(np.int32), (x[ids], y[ids]), mask,
+                np.full(n, 0.1, np.float32))
+
+    model.run_rounds(*span(0, 2))       # compile outside the guard
+    with sanitize.forbid_transfers():
+        out = model.run_rounds(*span(2, 2))
+    assert np.all(np.isfinite(np.asarray(out[0])))
+
+
+# ---------------- sampling policies -----------------------------------------
+
+def test_uniform_mode_matches_uniform_sampler_exactly():
+    """RoundScheduler('uniform').select IS UniformSampler.select IS the
+    raw rng.choice — one shared stream, bit for bit."""
+    alive = np.arange(20)
+    for seed in (0, 3):
+        r1 = np.random.RandomState(seed)
+        r2 = np.random.RandomState(seed)
+        r3 = np.random.RandomState(seed)
+        sched = RoundScheduler(_cfg(num_clients=20), 20,
+                               ClientThroughputTracker(20))
+        for _ in range(50):
+            want = r1.choice(alive, W, replace=False)
+            np.testing.assert_array_equal(
+                UniformSampler().select(alive, W, r2, 0), want)
+            np.testing.assert_array_equal(
+                sched.select(alive, W, r3), want)
+
+
+def test_throughput_sampler_fairness_floor():
+    """Satellite: over many rounds the empirical participation
+    distribution respects the exploration floor — even the slowest
+    client keeps at least ~floor/num_alive of the per-slot selection
+    mass — while fast clients are measurably favored."""
+    N, slots, floor = 20, 5, 0.2
+    tracker = ClientThroughputTracker(N)
+    rates = np.full(N, 10.0, np.float32)
+    rates[:4] = 0.5                     # chronically slow clients
+    tracker.rate[:] = rates
+    tracker.completions[:] = 1
+    sampler = ThroughputAwareSampler(0, tracker, explore_floor=floor)
+    counts = np.zeros(N)
+    R = 3000
+    for r in range(R):
+        counts[sampler.select(np.arange(N), slots, None, r)] += 1
+    share = counts / (R * slots)
+    # floor bound: first-draw probability >= floor/N per slot; the
+    # without-replacement draw only raises later-slot inclusion odds.
+    # 0.7 slack absorbs sampling noise at R=3000.
+    assert share.min() >= 0.7 * floor / N, share
+    # and the policy still does its job: fast clients participate far
+    # more than slow ones
+    assert share[4:].mean() > 3.0 * share[:4].mean()
+    # every client got measured-able participation (> 0)
+    assert (counts > 0).all()
+
+
+def test_throughput_sampler_unmeasured_neutral_prior():
+    """Unmeasured clients take the MEDIAN measured rate: they are
+    neither starved (slowest) nor flooded (fastest)."""
+    tracker = ClientThroughputTracker(3)
+    tracker.rate[:] = [2.0, 8.0, 0.0]   # client 2 unmeasured
+    s = ThroughputAwareSampler(0, tracker, explore_floor=0.0)
+    p = s.weights(np.arange(3))
+    assert p[0] < p[2] < p[1]
+    np.testing.assert_allclose(p.sum(), 1.0)
+
+
+def test_overprovision_math():
+    # no target: fill every slot (the identity default)
+    assert overprovision(0, 8, 100, 0.5) == 8
+    # target 4 at 50% survival -> sample 8
+    assert overprovision(4, 8, 100, 0.5) == 8
+    # capped by slots and by alive population
+    assert overprovision(4, 8, 5, 0.1) == 5
+    assert overprovision(4, 6, 100, 0.1) == 6
+    # full survival -> exactly the target
+    assert overprovision(3, 8, 100, 1.0) == 3
+    # degenerate survival estimates clamp instead of exploding
+    assert overprovision(2, 8, 100, 0.0) == 8
+    assert overprovision(2, 8, 100, 2.0) == 2
+
+
+# ---------------- deadline policy -------------------------------------------
+
+def test_deadline_policy_quantile_and_floors():
+    tracker = ClientThroughputTracker(8)
+    tracker.rate[:] = [1.0, 2.0, 4.0, 8.0, 8.0, 8.0, 8.0, 0.0]
+    pol = DeadlinePolicy(tracker, quantile=0.5, min_work=0.25)
+    ids = np.arange(8)
+    ex = np.full(8, 8.0)
+    d = pol.decide(ids, ex)
+    # estimates: [8, 4, 2, 1, 1, 1, 1, inf]; median of the 7 finite
+    # values is 1.0
+    assert d.deadline_s == pytest.approx(1.0)
+    assert d.est_round_s == pytest.approx(8.0)
+    # expected realized time honors the min_work floor: the floored
+    # slowest client still runs 0.25 * 8 = 2s past the 1s deadline
+    assert d.expected_round_s == pytest.approx(2.0)
+    w = d.work
+    assert w is not None
+    # slowest clients floored at min_work, mid client at deadline/est
+    assert w[0] == pytest.approx(0.25)          # 1/8 < floor
+    assert w[1] == pytest.approx(0.25)          # 1/4 hits the floor
+    assert w[2] == pytest.approx(0.5)
+    np.testing.assert_array_equal(w[3:7], 1.0)
+    # UNMEASURED client is never truncated
+    assert w[7] == 1.0
+
+
+def test_deadline_policy_cold_start_no_deadline():
+    """With nothing measured there is no deadline — and no NaN or
+    zero-division anywhere on the path."""
+    tracker = ClientThroughputTracker(4)
+    pol = DeadlinePolicy(tracker, quantile=0.9)
+    with np.errstate(all="raise"):
+        d = pol.decide(np.arange(4), np.full(4, 8.0))
+    assert d == (None, None, None, None)
+
+
+def test_tracker_excludes_idle_pads():
+    """An idle over-provisioned pad slot (scheduled=0) is excluded
+    from the tracker ENTIRELY — unlike a genuine dropped client, whose
+    participation counts. Otherwise pads depress the completion ratio
+    the scheduler's survival estimate reads, inflating the next
+    round's over-provisioning (a self-reinforcing error)."""
+    tr = ClientThroughputTracker(6)
+    tr.update_round([0, 1, 2, 3], [4.0, 4.0, 0.0, 0.0],
+                    round_seconds=1.0,
+                    scheduled=np.array([1.0, 1.0, 1.0, 0.0]))
+    # slot 3 was a pad: no participation; slot 2 was a genuine
+    # zero-example (dropped) participant: participation, no completion
+    assert list(tr.participations[:4]) == [1, 1, 1, 0]
+    assert list(tr.completions[:4]) == [1, 1, 0, 0]
+    # survivors mask composes with the scheduled filter
+    tr.update_round([0, 1, 2, 3], [4.0, 4.0, 4.0, 4.0],
+                    round_seconds=1.0,
+                    survivors=np.array([0.0, 1.0, 1.0, 1.0]),
+                    scheduled=np.array([1.0, 1.0, 1.0, 0.0]))
+    assert list(tr.participations[:4]) == [2, 2, 2, 0]
+    assert list(tr.completions[:4]) == [1, 2, 1, 0]
+
+
+def test_tracker_cold_start_estimates():
+    """Satellite: estimate_round_seconds' documented cold-start path —
+    conservative finite defaults on request, never NaN/0-division,
+    zero examples estimate zero seconds."""
+    tr = ClientThroughputTracker(4)
+    with np.errstate(all="raise"):
+        # nothing measured, default: +inf sentinel (except zero work)
+        est = tr.estimate_round_seconds([0, 1], [8.0, 0.0])
+        assert np.isinf(est[0]) and est[1] == 0.0
+        # nothing measured, cold start: the conservative default
+        est = tr.estimate_round_seconds([0, 1], [8.0, 8.0],
+                                        cold_start_seconds=30.0)
+        np.testing.assert_array_equal(est, [30.0, 30.0])
+        # one measured peer: unmeasured estimate at the SLOWEST
+        # measured rate (conservative), not the cold-start constant
+        tr.update_round([0, 1], [4.0, 16.0], round_seconds=2.0)
+        est = tr.estimate_round_seconds([2, 0], [8.0, 8.0],
+                                        cold_start_seconds=30.0)
+        assert est[0] == pytest.approx(8.0 / 2.0)  # slowest rate = 2/s
+        assert est[1] == pytest.approx(8.0 / 2.0)
+    assert np.isfinite(est).all()
+
+
+# ---------------- end-to-end adaptation (acceptance) ------------------------
+
+def _run_profiled(tmp_path, sampler, tag, rounds=30, num_clients=12,
+                  slow_clients=(0, 1, 2)):
+    """One scheduled run under a scripted slow profile: clients in
+    `slow_clients` complete only 25% of their work whenever sampled
+    (FaultSchedule.slow, re-scripted per round for whatever slot they
+    landed in). A deterministic session clock (1s/round) feeds the
+    tracker through the REAL jitted round's processed-example counts.
+    Returns (model, schedule journal records)."""
+    cfg = _cfg(sampler=sampler, deadline_quantile=0.9,
+               deadline_min_work=0.1, num_workers=4,
+               num_clients=num_clients, explore_floor=0.05)
+    model, _ = _fed_model(cfg)
+    jpath = str(tmp_path / f"{tag}.jsonl")
+    clock = itertools.count(0.0, 1.0)
+    sess = TelemetrySession(journal=RunJournal(jpath),
+                            clock=lambda: next(clock))
+    model.attach_telemetry(sess)
+    sched = RoundScheduler(cfg, num_clients, model.throughput)
+    model.attach_scheduler(sched)
+    sched.begin_epoch(0)
+    pool = _client_pool(num_clients)
+    rng = np.random.RandomState(11)
+    slow = set(slow_clients)
+    for r in range(rounds):
+        chosen = np.asarray(sched.select(np.arange(num_clients), 4,
+                                         rng))
+        sched.commit_round(chosen, np.full(4, float(B)))
+        slow_slots = {s: 0.25 for s in range(4) if chosen[s] in slow}
+        model.set_fault_schedule(
+            FaultSchedule(slow={r: slow_slots}) if slow_slots
+            else None)
+        model(_batch_for(chosen, pool))
+    sess.close(ok=True)
+    records, problems = validate_journal(jpath)
+    assert not problems, problems
+    return model, [x for x in records if x["event"] == "schedule"]
+
+
+def test_adaptation_slow_clients_measured_and_deprioritized(tmp_path):
+    """Acceptance: FaultSchedule.slow clients get measured by the
+    tracker END TO END (their EMA rate derives from the jitted
+    round's truncated processed-example counts) and the throughput
+    policy + deadline measurably reduce estimated round time vs
+    uniform sampling — asserted via the journaled schedule events."""
+    model_u, sched_u = _run_profiled(tmp_path, "uniform", "uni")
+    model_t, sched_t = _run_profiled(tmp_path, "throughput", "thr")
+
+    # the slow clients were measured: their EMA is a fraction of the
+    # fast clients' (0.25 work -> 1 example/round vs 4)
+    for model in (model_u, model_t):
+        rate = model.throughput.rate
+        measured_slow = rate[:3][rate[:3] > 0]
+        assert measured_slow.size, "no slow client ever measured"
+        assert measured_slow.max() < 0.5 * rate[3:][rate[3:] > 0].min()
+
+    # deadline decisions journaled once measurements exist
+    assert any(s.get("deadline_s") is not None for s in sched_u)
+    assert any(s.get("truncated_slots", 0) > 0 for s in sched_u)
+
+    def steady_est(events):
+        vals = [s["est_round_s"] for s in events[-12:]
+                if s.get("est_round_s") is not None]
+        assert vals, "no estimated round times journaled"
+        return float(np.mean(vals))
+
+    # throughput-aware sampling avoids the slow clients, so its
+    # expected (un-deadlined) round time is measurably lower
+    assert steady_est(sched_t) < 0.6 * steady_est(sched_u), (
+        steady_est(sched_t), steady_est(sched_u))
+    # and the slow clients are deprioritized but NOT starved (floor)
+    part = model_t.throughput.participations
+    assert part[:3].sum() > 0
+    assert part[3:].mean() > part[:3].mean()
+
+
+# ---------------- crash -> resume (acceptance) ------------------------------
+
+def _drive_scheduled(model, sched, pool, first, last, rng,
+                     checkpoint=None):
+    """Per-round scheduled driving with DETERMINISTIC tracker feeding
+    (scripted seconds, full counts): selection for round r always sees
+    the tracker state an uninterrupted run had at that point."""
+    num_clients = model.num_clients
+    sched.begin_epoch(first)
+    for r in range(first, last):
+        slot_ids, active = _schedule_round(sched, num_clients, rng)
+        model(_batch_for(slot_ids, pool, active))
+        model.throughput.update_round(
+            slot_ids, np.full(W, float(B)) * active,
+            round_seconds=1.0 + 0.1 * r)
+        if checkpoint is not None:
+            checkpoint()
+
+
+def test_scheduled_crash_resume_bit_exact(ckpt_dir):
+    """Acceptance: crash -> resume of a scheduled run (throughput
+    sampling + deadline + over-provisioning + random dropout) is
+    bit-exact — ServerState, tracker state, and scheduler counters all
+    land where the uninterrupted run lands."""
+    R = 8
+    kw = dict(sampler="throughput", deadline_quantile=0.8,
+              target_survivors=2, client_dropout=0.2, num_clients=12)
+    pool = _client_pool(12)
+
+    # uninterrupted reference
+    cfg = _cfg(**kw)
+    model_a, _ = _fed_model(cfg)
+    sched_a = RoundScheduler(cfg, 12, model_a.throughput)
+    model_a.attach_scheduler(sched_a)
+    _drive_scheduled(model_a, sched_a, pool, 0, R,
+                     np.random.RandomState(2))
+    want = np.asarray(model_a.server.ps_weights)
+
+    # crashing run: checkpoint after every completed round, injected
+    # preemption after round 4 (its post-round checkpoint never runs)
+    from commefficient_tpu.utils.checkpoint import (
+        load_latest, save_rotating,
+    )
+    prefix = os.path.join(ckpt_dir, "sched")
+    model_b, _ = _fed_model(cfg)
+    sched_b = RoundScheduler(cfg, 12, model_b.throughput)
+    model_b.attach_scheduler(sched_b)
+    model_b.set_fault_schedule(FaultSchedule(crash_after=4))
+
+    def save_b():
+        save_rotating(prefix, model_b.server, model_b.clients,
+                      keep_last=2,
+                      fingerprint=model_b.checkpoint_fingerprint,
+                      throughput=model_b.throughput.state_dict(),
+                      scheduler=sched_b.state_dict())
+
+    with pytest.raises(InjectedFault):
+        _drive_scheduled(model_b, sched_b, pool, 0, R,
+                         np.random.RandomState(2), checkpoint=save_b)
+
+    # fresh process: restore, then finish the remaining rounds
+    model_c, _ = _fed_model(cfg)
+    sched_c = RoundScheduler(cfg, 12, model_c.throughput)
+    model_c.attach_scheduler(sched_c)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None and ckpt.scheduler is not None
+    model_c.load_state(ckpt)
+    done = int(np.asarray(ckpt.server.round_idx))
+    assert done == 4  # rounds 0-3 checkpointed; round 4 was lost
+    _drive_scheduled(model_c, sched_c, pool, done, R,
+                     np.random.RandomState(2))
+
+    np.testing.assert_array_equal(
+        np.asarray(model_c.server.ps_weights), want,
+        err_msg="scheduled crash -> resume diverged")
+    for k, v in model_a.throughput.state_dict().items():
+        np.testing.assert_array_equal(
+            v, model_c.throughput.state_dict()[k], err_msg=f"thr {k}")
+    for k, v in sched_a.state_dict().items():
+        np.testing.assert_array_equal(
+            v, sched_c.state_dict()[k], err_msg=f"sched {k}")
+
+
+def test_skip_replay_does_not_recount_scheduler_counters():
+    """The DRIVER resume path replays the resumed epoch's skipped head
+    through the sampler (FedLoader.epoch(skip=) skips materialization
+    only — selection still runs), so commit_round must not recount
+    rounds the restored sched_* counters already include. The
+    high-water mark makes each round index count exactly once across
+    the run's whole timeline."""
+    cfg = _cfg(sampler="throughput", deadline_quantile=0.8,
+               num_clients=12, num_workers=4)
+    tracker = ClientThroughputTracker(12)
+    tracker.rate[:] = np.linspace(1.0, 4.0, 12)
+    tracker.completions[:] = 1
+
+    def commit(sched, r0, n):
+        rng = np.random.RandomState(3)
+        sched.begin_epoch(r0)
+        for _ in range(n):
+            ids = sched.select(np.arange(12), 4, rng)
+            sched.commit_round(ids, np.full(len(ids), float(B)))
+
+    # uninterrupted: one epoch of 10 rounds
+    ref = RoundScheduler(cfg, 12, tracker)
+    commit(ref, 0, 10)
+
+    # interrupted at round 6, resumed mid-epoch: the driver restores
+    # the counters, then replays rounds 0..5 (skip head) + runs 6..9
+    first = RoundScheduler(cfg, 12, tracker)
+    commit(first, 0, 6)
+    resumed = RoundScheduler(cfg, 12, tracker)
+    resumed.load_state_dict(first.state_dict())
+    commit(resumed, 0, 10)   # replay from epoch start, like epoch(skip=6)
+
+    for k, v in ref.state_dict().items():
+        np.testing.assert_array_equal(
+            v, resumed.state_dict()[k], err_msg=k)
+    assert resumed.rounds_scheduled == 10
+
+
+def test_scheduler_state_checkpoint_roundtrip(ckpt_dir):
+    from commefficient_tpu.utils.checkpoint import (
+        load_checkpoint, save_checkpoint,
+    )
+    cfg = _cfg(sampler="throughput", deadline_quantile=0.5)
+    model, _ = _fed_model(cfg)
+    sched = RoundScheduler(cfg, W, model.throughput)
+    model.attach_scheduler(sched)
+    sched.rounds_scheduled = 17
+    sched.clients_sampled = 120
+    sched.deadline_rounds = 9
+    sched.truncated_slots = 4
+    sched.last_deadline_s = 2.625
+    path = os.path.join(ckpt_dir, "s")
+    save_checkpoint(path, model.server, model.clients,
+                    fingerprint=model.checkpoint_fingerprint,
+                    scheduler=sched.state_dict())
+    fresh, _ = _fed_model(cfg)
+    fresh_sched = RoundScheduler(cfg, W, fresh.throughput)
+    fresh.attach_scheduler(fresh_sched)
+    fresh.load_state(load_checkpoint(path))
+    for k, v in sched.state_dict().items():
+        np.testing.assert_array_equal(
+            v, fresh_sched.state_dict()[k], err_msg=k)
+
+
+def test_idle_slots_are_bit_exact_dropout(ckpt_dir):
+    """Over-provisioning's surplus slots behave EXACTLY like scripted
+    dropped clients: same ServerState bits as a run that scripts the
+    same slots dead, state rows of pad clients untouched, accounting
+    charges them nothing."""
+    cfg = _cfg(num_clients=12, target_survivors=4)
+    model_s, _ = _fed_model(cfg)
+    sched = RoundScheduler(cfg, 12, model_s.throughput)
+    model_s.attach_scheduler(sched)
+    sched.begin_epoch(0)
+    rng = np.random.RandomState(4)
+    slot_ids, active = _schedule_round(sched, 12, rng)
+    assert active.sum() == 4 and (active[4:] == 0).all()
+    pool = _client_pool(12)
+    out_s = model_s(_batch_for(slot_ids, pool, active))
+
+    # reference: same slots scripted dead via FaultSchedule
+    cfg_ref = _cfg(num_clients=12)
+    model_r, _ = _fed_model(cfg_ref)
+    model_r.set_fault_schedule(FaultSchedule(
+        drop_slots={0: list(np.where(active == 0)[0])}))
+    out_r = model_r(_batch_for(slot_ids, pool, active))
+    np.testing.assert_array_equal(
+        np.asarray(model_s.server.ps_weights),
+        np.asarray(model_r.server.ps_weights))
+    # accounting charged the pad clients nothing, identically to the
+    # scripted-drop reference ([-1] is the per-client upload vector)
+    pad_ids = slot_ids[active == 0]
+    np.testing.assert_array_equal(out_s[-1], out_r[-1])
+    assert (np.asarray(out_s[-1])[pad_ids] == 0).all()
+    assert float(np.asarray(model_s.server.round_idx)) == 1.0

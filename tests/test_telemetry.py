@@ -340,6 +340,81 @@ def test_bench_digest_shares_schema(tmp_path, monkeypatch):
     assert len(validate_journal(jpath)[0]) == 1  # disabled -> no append
 
 
+def test_round_comm_bytes_journaled(tmp_path):
+    """ISSUE 5 satellite: the accountant's per-round byte totals ride
+    the round events (per-round path) and run_end carries the
+    cumulative pair — and the whole journal still validates."""
+    model, _ = _fed_model()
+    sess, jpath = _session(tmp_path)
+    model.attach_telemetry(sess)
+    for ids, data, mask in _rounds(3):
+        model((ids, data, mask))
+    sess.close(ok=True)
+    records, problems = validate_journal(jpath)
+    assert not problems, problems
+    rounds = [r for r in records if r["event"] == "round"]
+    assert len(rounds) == 3
+    for rec in rounds:
+        # uncompressed upload: 8 clients x D floats x 4 bytes
+        assert rec["up_bytes"] == 8 * D * 4.0
+        assert rec["down_bytes"] >= 0
+    # round 1's download charges the weights round 0 changed
+    assert rounds[1]["down_bytes"] > 0
+    end = records[-1]
+    assert end["event"] == "run_end"
+    assert end["up_bytes_total"] == sum(r["up_bytes"] for r in rounds)
+    assert end["down_bytes_total"] == sum(r["down_bytes"]
+                                          for r in rounds)
+
+
+def test_span_comm_bytes_journaled(tmp_path):
+    """Scanned path: every round event of a span carries its byte
+    totals (the accounting loop feeds on_span's comm_rows)."""
+    model, _ = _fed_model()
+    sess, jpath = _session(tmp_path)
+    model.attach_telemetry(sess)
+    stream = _rounds(3)
+    model.run_rounds(
+        np.stack([s[0] for s in stream]),
+        tuple(np.stack([s[1][i] for s in stream]) for i in range(2)),
+        np.stack([s[2] for s in stream]),
+        np.full(3, 0.1, np.float32))
+    sess.close(ok=True)
+    records, problems = validate_journal(jpath)
+    assert not problems, problems
+    rounds = [r for r in records if r["event"] == "round"]
+    assert len(rounds) == 3
+    assert all(r["up_bytes"] == 8 * D * 4.0 for r in rounds)
+    assert records[-1]["up_bytes_total"] == 3 * 8 * D * 4.0
+
+
+def test_validate_journal_comm_invariants(tmp_path):
+    """Byte-total invariants are CHECKED, not just emitted: negative
+    or non-numeric totals fail, and a run_end cumulative smaller than
+    the segment's per-round sum fails."""
+    jpath = str(tmp_path / "comm.jsonl")
+    j = RunJournal(jpath)
+    j.event("round", round=0, down_bytes=-5.0)
+    j.event("round", round=1, up_bytes="many")
+    _, problems = validate_journal(jpath)
+    assert any("down_bytes" in p for p in problems)
+    assert any("up_bytes" in p for p in problems)
+
+    jpath2 = str(tmp_path / "short.jsonl")
+    j2 = RunJournal(jpath2)
+    j2.event("round", round=0, down_bytes=2.0 * 1024 ** 2,
+             up_bytes=50.0)
+    j2.event("run_end", down_bytes_total=10.0, up_bytes_total=50.0)
+    _, problems = validate_journal(jpath2)
+    assert any("down_bytes_total" in p for p in problems)
+    assert not any("up_bytes_total" in p for p in problems)
+
+    # summarize surfaces the totals
+    from commefficient_tpu.telemetry.journal import summarize
+    recs, _ = validate_journal(jpath2)
+    assert summarize(recs)["down_mib"] == pytest.approx(2.0)
+
+
 def test_parse_profile_spans():
     assert parse_profile_spans("") is None
     assert parse_profile_spans("2:4") == (2, 4)
