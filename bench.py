@@ -392,6 +392,42 @@ def main() -> int:
     except Exception as e:
         log(f"scheduled-round measurement failed: {e}")
 
+    # kernel-backend + table-dtype sweep (ISSUE 6 satellite): the same
+    # scanned program with (a) the compression hot path on the fused
+    # Pallas kernels and (b) the sketch table quantized for the wire.
+    # Each variant is a config replace -> its own jitted digest under
+    # the same one-scalar sync discipline; any variant may time out or
+    # fail without killing the primary measurement (the axon-tunnel
+    # survival rule every secondary measurement here follows).
+    pallas_round_ms = None
+    try:
+        digest_pallas = build_digest(cfg.replace(kernel_backend="pallas"))
+        with alarm_guard(STAGE_TIMEOUT, "pallas compile+measure"):
+            float(np.asarray(digest_pallas(server, clients, batches,
+                                           lrs, key)))  # compile
+            pallas_round_ms = median_ms(
+                digest_pallas, (server, clients, batches, lrs, key),
+                divisor=ROUNDS)
+    except StageTimeout:
+        log("pallas-backend measurement timed out; omitting")
+    except Exception as e:
+        log(f"pallas-backend measurement failed: {e}")
+
+    table_dtype_ms = {}
+    for td in ("bf16", "int8"):
+        try:
+            digest_td = build_digest(cfg.replace(sketch_table_dtype=td))
+            with alarm_guard(STAGE_TIMEOUT, f"{td}-table compile+measure"):
+                float(np.asarray(digest_td(server, clients, batches,
+                                           lrs, key)))  # compile
+                table_dtype_ms[td] = median_ms(
+                    digest_td, (server, clients, batches, lrs, key),
+                    divisor=ROUNDS)
+        except StageTimeout:
+            log(f"{td}-table measurement timed out; omitting")
+        except Exception as e:
+            log(f"{td}-table measurement failed: {e}")
+
     out = {
         "metric": "cifar10_resnet9_sketch_round_time",
         "value": round(round_ms, 3),
@@ -414,6 +450,23 @@ def main() -> int:
         # device time, > 1.0 means the truncated work actually saved it
         out["value_scheduled"] = round(sched_round_ms, 3)
         out["vs_uniform_scheduled"] = round(round_ms / sched_round_ms, 3)
+    if pallas_round_ms is not None:
+        # fused-kernel round next to the XLA one: vs_xla_backend > 1.0
+        # means the Pallas hot path is faster than the XLA lowering of
+        # the same math (only meaningful on platform == "tpu"; the CPU
+        # fallback runs the kernels under the Pallas INTERPRETER, a
+        # correctness harness, so a CPU ratio measures the interpreter)
+        out["value_pallas"] = round(pallas_round_ms, 3)
+        out["vs_xla_backend"] = round(round_ms / pallas_round_ms, 3)
+    for td, ms in sorted(table_dtype_ms.items()):
+        out[f"value_table_{td}"] = round(ms, 3)
+    # bytes one client's sketch upload occupies per round at each wire
+    # dtype (Config.upload_bytes — the figure the accountant bills):
+    # the bytes-on-wire dimension of the sweep, reported even when a
+    # timing variant failed, since it is pure config math
+    out["upload_bytes_on_wire"] = {
+        td: cfg.replace(sketch_table_dtype=td).upload_bytes
+        for td in ("f32", "bf16", "int8")}
     add_flops_fields(out, flops_per_round, round_ms, device_kind)
     print(json.dumps(out), flush=True)
     return 0

@@ -16,6 +16,12 @@ Stages timed (bench geometry: ResNet9 D=6.57M, 5x500k sketch, k=50k,
   encode_sparse    server re-sketch of the k-sparse update
   masked_topk      dense top-k on [D] (true_topk/local_topk path)
   pack_change_bits accounting bitset pack (f32-dot reformulation)
+  encode_pallas_x1 / estimate_all_{xla,pallas} /
+  threshold_decode_pallas
+                   the ISSUE-6 fused kernel stages next to their XLA
+                   counterparts (VMEM-gated; skips are reported)
+  quant_roundtrip_{bf16,int8}
+                   sketch-table wire quantize+dequantize
   full_round       one train round (single, unscanned)
   scanned_round    per-round time of the 10-round scanned program
 
@@ -53,6 +59,7 @@ if os.environ.get("BENCH_IS_WORKER") == "1":
     from commefficient_tpu.federated import round as fround
     from commefficient_tpu.federated.accounting import pack_change_bits
     from commefficient_tpu.models import ResNet9
+    from commefficient_tpu.ops import kernels as pkern
     from commefficient_tpu.ops.flat import flatten_params, masked_topk
     from commefficient_tpu.ops.sketch import CSVec
     from commefficient_tpu.parallel.mesh import make_client_mesh
@@ -187,6 +194,36 @@ def main():
 
     # --- accounting bit-pack (the f32-dot reformulation) ---------------
     S["pack_change_bits"] = timeit(jax.jit(pack_change_bits), gvec)
+
+    # --- fused Pallas kernels, timed per kernel (ISSUE 6) --------------
+    # Each stage is its own jitted single-scalar digest (timeit
+    # scalarizes) — the per-kernel rows of PERF.md's stage table. A
+    # geometry past a kernel's VMEM gate reports the skip instead of
+    # silently timing the XLA fallback under a kernel's name.
+    sk_pl = CSVec(d=D, c=cfg.num_cols, r=cfg.num_rows,
+                  num_blocks=cfg.num_blocks, seed=42, backend="pallas")
+    if pkern.pallas_fits(sk_pl, "encode"):
+        S["encode_pallas_x1"] = timeit(jax.jit(sk_pl.encode), gvec)
+    else:
+        print("  encode_pallas_x1: skipped (VMEM gate)",
+              file=sys.stderr, flush=True)
+    S["estimate_all_xla"] = timeit(jax.jit(sketch.estimate_all), table)
+    if pkern.pallas_fits(sk_pl, "estimate"):
+        S["estimate_all_pallas"] = timeit(
+            jax.jit(lambda t: pkern.pallas_estimate_all(sk_pl, t)),
+            table)
+        S["threshold_decode_pallas"] = timeit(
+            jax.jit(lambda t: pkern.pallas_threshold_decode(
+                sk_pl, t, cfg.k)), table)
+    else:
+        print("  estimate/threshold pallas: skipped (VMEM gate)",
+              file=sys.stderr, flush=True)
+
+    # --- quantized wire transport round-trip (--sketch_table_dtype) ----
+    S["quant_roundtrip_bf16"] = timeit(
+        jax.jit(lambda t: pkern.wire_roundtrip(t, "bf16")), table)
+    S["quant_roundtrip_int8"] = timeit(
+        jax.jit(lambda t: pkern.wire_roundtrip(t, "int8")), table)
 
     # --- full round ----------------------------------------------------
     train_round = fround.make_train_fn(loss_fn, unravel, cfg, mesh)

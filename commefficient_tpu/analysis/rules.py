@@ -1,4 +1,4 @@
-"""graftlint rules GL001-GL006.
+"""graftlint rules GL001-GL008.
 
 Each rule is a function ``check(module: ModuleInfo) -> Iterator[
 Violation]`` over one parsed file. The rules are deliberately
@@ -87,10 +87,14 @@ class ModuleInfo:
 
 
 # transform entry points whose function-valued arguments become traced
+# (pallas_call included: a Pallas kernel body is traced code — the
+# same host-sync/control-flow hazards apply inside it, plus Mosaic's
+# own restrictions)
 _TRACE_ENTRY_CALLS = frozenset({
     "jit", "pmap", "vmap", "grad", "value_and_grad", "scan", "cond",
     "while_loop", "fori_loop", "switch", "shard_map", "checkpoint",
     "remat", "associative_scan", "custom_vjp", "custom_jvp",
+    "pallas_call",
 })
 _TRACE_DECORATORS = frozenset({
     "jit", "pmap", "vmap", "shard_map", "checkpoint", "remat",
@@ -557,6 +561,47 @@ def check_gl007(module: ModuleInfo) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GL008 — exact large-k top-k inside traced code
+
+# Exact `lax.top_k` lowers to a sorting network on TPU whose cost
+# grows with k * d — the ~125 ms/round regression class PERF.md §1
+# measured at k=50k (vs ~1 ms for the approx_max_k partial reduce).
+# Flag only a STATIC k at or above this bound: small-k exact top-k is
+# fine (and is what approx_max_k itself degenerates to), and a
+# non-constant k is invisible to a syntactic rule (precision over
+# recall, like every rule here).
+GL008_MIN_K = 2048
+
+
+def check_gl008(module: ModuleInfo) -> Iterator[Violation]:
+    for node in _walk_traced(module):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if _terminal(name) != "top_k" or not name:
+            continue
+        # jax.lax.top_k / lax.top_k; jnp has no top_k, and a bare or
+        # differently-rooted `top_k` is someone else's function
+        root = name.rsplit(".", 1)[0]
+        if root not in ("lax", "jax.lax"):
+            continue
+        k_arg = node.args[1] if len(node.args) >= 2 else next(
+            (kw.value for kw in node.keywords if kw.arg == "k"), None)
+        if not (isinstance(k_arg, ast.Constant)
+                and isinstance(k_arg.value, int)
+                and k_arg.value >= GL008_MIN_K):
+            continue
+        yield Violation(
+            module.path, node.lineno, node.col_offset, "GL008",
+            f"exact `{name}` with static k={k_arg.value} inside traced "
+            "code: exact top-k lowers to a full sorting network on TPU "
+            "(the ~125 ms/round regression class in PERF.md); use "
+            "`jax.lax.approx_max_k` (error feedback absorbs the ~5% "
+            "recall miss) or the fused selection kernel "
+            "(ops/kernels/sketch_pallas.pallas_threshold_decode)")
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "GL001": check_gl001,
@@ -566,6 +611,7 @@ ALL_RULES = {
     "GL005": check_gl005,
     "GL006": check_gl006,
     "GL007": check_gl007,
+    "GL008": check_gl008,
 }
 
 RULE_DOCS = {
@@ -582,4 +628,7 @@ RULE_DOCS = {
     "GL006": "file write without the atomic .tmp + os.replace pattern",
     "GL007": "shard_map/pjit output layout left unconstrained (no "
              "out_specs/out_shardings, no with_sharding_constraint)",
+    "GL008": "exact lax.top_k with large static k in traced code "
+             "(TPU sorting-network cliff; use approx_max_k or the "
+             "fused selection kernel)",
 }

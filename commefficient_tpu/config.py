@@ -108,6 +108,27 @@ class Config:
     # the download budget that keeps staleness bounded is a multiple
     # of k — the tradeoff benchmarks/convergence.py sweeps.
     down_k: int = 0
+    # kernel backend for the compression hot path (ISSUE 6,
+    # commefficient_tpu/ops/kernels): "xla" — the default, bit-
+    # identical to the pre-kernel program (the dispatch gates are
+    # untaken, not re-proven) — or "pallas", which routes count-sketch
+    # encode / estimate-all / the large-d threshold decode through
+    # fused Pallas TPU kernels (interpret-mode on CPU, so tests
+    # execute the same kernel bodies). Static config: either choice
+    # traces the same THREE round programs, stays transfer-guard
+    # clean, and resumes bit-exactly (tests/test_kernels.py).
+    kernel_backend: str = "xla"
+    # wire dtype of the transmitted [r, c] sketch table (sketch mode
+    # only): "f32" (default — the transport code path is the identity,
+    # bit-identical to a build without the flag), "bf16", or "int8"
+    # (symmetric per-row scales). Quantization rounds the shard's
+    # client-sum table before the psum; the server's virtual error
+    # feedback absorbs the rounding noise the same way it absorbs
+    # sketch compression noise (ops/kernels/quant.py), telemetry's
+    # estimate_residual metric gauges whether accuracy pays for it,
+    # and the accountant bills upload bytes at the WIRE element size
+    # (Config.upload_bytes).
+    sketch_table_dtype: str = "f32"
 
     # optimization (utils.py:150-162)
     local_momentum: float = 0.9
@@ -301,6 +322,22 @@ class Config:
         }[self.mode]
 
     @property
+    def upload_bytes(self) -> int:
+        """Bytes uploaded per participating client per round AT THE
+        WIRE DTYPE — the quantity the accountant bills and journals
+        (ISSUE 6 accounting satellite). For sketch mode this is the
+        [r, c] table at sketch_table_dtype's element size (plus int8's
+        per-row f32 scales); every other mode transmits f32, so it is
+        4 x upload_floats exactly as before."""
+        if self.mode == "sketch":
+            from commefficient_tpu.ops.kernels.quant import (
+                wire_table_bytes,
+            )
+            return wire_table_bytes(self.num_rows, self.num_cols,
+                                    self.sketch_table_dtype)
+        return 4 * self.upload_floats
+
+    @property
     def defer_sketch_encode(self) -> bool:
         """Sketch linearity optimization: when nothing nonlinear
         touches the per-client compressed quantity — no per-client DP
@@ -479,6 +516,20 @@ class Config:
                 "process-local wall-clock throughput measurements and "
                 "would diverge across controllers (coordinator-"
                 "broadcast scheduling is the named ROADMAP opening)")
+        if self.kernel_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown kernel_backend {self.kernel_backend!r} "
+                "(choices: xla, pallas — commefficient_tpu/ops/kernels)")
+        if self.sketch_table_dtype not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"unknown sketch_table_dtype {self.sketch_table_dtype!r} "
+                "(choices: f32, bf16, int8)")
+        if self.sketch_table_dtype != "f32" and self.mode != "sketch":
+            # fail loud rather than silently transmitting f32: the flag
+            # names the SKETCH table, and no other mode has one
+            raise ValueError(
+                "--sketch_table_dtype quantizes the transmitted sketch "
+                f"table and requires --mode sketch (got {self.mode!r})")
         if self.down_k < 0:
             raise ValueError("down_k must be >= 0 (0 = share the upload k)")
         if self.down_k > self.grad_size > 0:
@@ -542,6 +593,20 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--down_k", type=int, default=0,
                    help="download top-k budget (0 = share --k); see "
                         "Config.down_k")
+    p.add_argument("--kernel_backend", choices=("xla", "pallas"),
+                   default="xla",
+                   help="compression hot-path kernels: xla (default, "
+                        "bit-identical to the pre-kernel program) or "
+                        "pallas (fused TPU kernels for sketch encode/"
+                        "estimate/threshold decode; interpret-mode "
+                        "off-TPU — commefficient_tpu/ops/kernels)")
+    p.add_argument("--sketch_table_dtype",
+                   choices=("f32", "bf16", "int8"), default="f32",
+                   help="wire dtype of the transmitted sketch table "
+                        "(sketch mode): bf16/int8 quantize the client-"
+                        "sum table before aggregation — error feedback "
+                        "absorbs the rounding noise, the accountant "
+                        "bills bytes at this element size")
 
     p.add_argument("--local_momentum", type=float, default=0.9)
     p.add_argument("--virtual_momentum", type=float, default=0)

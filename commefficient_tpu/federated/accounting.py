@@ -6,6 +6,10 @@ Semantics parity:
   * upload bytes per participating client per round: 4 bytes x
     mode-dependent float count (reference :291-299) — grad_size for
     uncompressed/true_topk/fedavg, k for local_topk, r*c for sketch.
+    One deliberate divergence (ISSUE 6): a sketch table quantized for
+    the wire (--sketch_table_dtype bf16/int8) is billed at the WIRE
+    element size (Config.upload_bytes), not at f32 — the reference
+    has no quantized transport to bill.
     The local_topk count stays the ANALYTIC k, exactly like the
     reference's; above ops/flat.py's TOPK_THRESHOLD_MIN_D the actual
     transmitted support is k within ~1% sampling noise — PLUS any
@@ -133,6 +137,16 @@ class CommAccountant:
         if frozen_count and cfg.mode in ("uncompressed", "true_topk",
                                          "fedavg"):
             self.upload_floats = cfg.grad_size - frozen_count
+        # billed upload BYTES at the wire dtype (ISSUE 6 accounting
+        # fix): a bf16/int8 sketch table must not be charged at f32
+        # element size. Every non-sketch mode transmits f32 so the
+        # byte count is 4 x floats exactly as before; sketch mode
+        # defers to Config.upload_bytes (table elements at
+        # sketch_table_dtype size + int8's per-row scales). These are
+        # the `up_bytes` the journal records (api.py -> telemetry).
+        self.upload_bytes = (float(cfg.upload_bytes)
+                             if cfg.mode == "sketch"
+                             else 4.0 * self.upload_floats)
         # local_topk blowout observability (module docstring: the
         # upload charge stays the ANALYTIC k): ops/flat.py's
         # sampled_threshold_mask can select MORE than k on threshold
@@ -203,7 +217,7 @@ class CommAccountant:
             self.stale += 1
 
         upload = np.zeros(self.num_clients)
-        upload[participating] = 4.0 * self.upload_floats
+        upload[participating] = self.upload_bytes
 
         if self.cfg.mode == "local_topk" and prev_changed_words is not None:
             # realized support of the previous round's aggregate

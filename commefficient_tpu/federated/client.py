@@ -225,7 +225,7 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
         else:
             sketch = CSVec(d=cfg.grad_size, c=cfg.num_cols,
                            r=cfg.num_rows, num_blocks=cfg.num_blocks,
-                           seed=42)
+                           seed=42, backend=cfg.kernel_backend)
             table = sketch.encode(grad)
             if cfg.max_grad_norm is not None:
                 table = clip_table_to_l2(

@@ -342,6 +342,20 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             # upload compression on the wire, exactly like the
             # reference's NCCL reduce of sketch tables.
             local_sum = fserver.args2sketch(cfg).encode(local_sum)
+        if cfg.mode == "sketch" and cfg.sketch_table_dtype != "f32":
+            # quantized sketch transport (--sketch_table_dtype): the
+            # shard's client-sum table rides the wire at bf16/int8 —
+            # quantize at the sender, dequantize before the
+            # aggregation/decode. wire_roundtrip is the IDENTITY for
+            # f32, and the branch itself is static config, so the
+            # default traces the exact pre-quantization program. The
+            # rounding noise lands in the server's virtual error
+            # accumulator like any other compression noise
+            # (ops/kernels/quant.py); the accountant bills the wire
+            # bytes (Config.upload_bytes).
+            from commefficient_tpu.ops.kernels import wire_roundtrip
+            local_sum = wire_roundtrip(local_sum,
+                                       cfg.sketch_table_dtype)
         transmit = jax.lax.psum(local_sum, "clients")
         total = jax.lax.psum(counts.sum(), "clients")
         return (transmit, total, new_err, new_vel, new_w_rows,

@@ -87,6 +87,24 @@ def _topk_threshold_1d(v: jax.Array, k: int) -> jax.Array:
     return sampled_threshold_mask(v, k)
 
 
+def threshold_from_sq_sample(sq_sample: jax.Array, k: int,
+                             total: int) -> jax.Array:
+    """THE k-th-largest-square threshold estimate from a sample of
+    squared magnitudes — one copy of the quantile math (ks clamp,
+    approx_max_k, tiny floor) shared by sampled_threshold_mask below
+    and the fused Pallas decode (ops/kernels/sketch_pallas), so the
+    two routes' selection contracts cannot drift apart.
+
+    sq_sample: [n] squared values sampled ~uniformly from a vector of
+    `total` squared values; returns the scalar threshold: a vector
+    with fewer than k nonzeros floors the threshold at f32-tiny so
+    callers' `sq >= thr` select exactly the nonzeros, not everything."""
+    n = sq_sample.shape[0]
+    ks = max(1, min(int(round(k * n / total)), n))
+    vals, _ = jax.lax.approx_max_k(sq_sample, ks)
+    return jnp.maximum(vals[-1], jnp.finfo(jnp.float32).tiny)
+
+
 def sampled_threshold_mask(v: jax.Array, k: int) -> jax.Array:
     """THE sampled-threshold selection (one algorithm, shared by
     masked_topk's large-d route and CSVec.decode_topk_dense): estimate
@@ -113,13 +131,7 @@ def sampled_threshold_mask(v: jax.Array, k: int) -> jax.Array:
     k = min(k, d)
     sq = v * v
     stride = max(1, d // _TOPK_SAMPLE)
-    sample = sq[::stride]
-    ks = max(1, min(int(round(k * sample.shape[0] / d)),
-                    sample.shape[0]))
-    vals, _ = jax.lax.approx_max_k(sample, ks)
-    # tiny floor: a vector with fewer than k nonzeros (thr would be 0)
-    # selects exactly its nonzeros instead of everything
-    thr = jnp.maximum(vals[-1], jnp.finfo(jnp.float32).tiny)
+    thr = threshold_from_sq_sample(sq[::stride], k, d)
     return jnp.where(sq >= thr, v, 0.0)
 
 

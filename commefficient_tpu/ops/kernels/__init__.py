@@ -1,0 +1,30 @@
+"""Pallas TPU kernel backend for the compression hot path (ISSUE 6).
+
+The package holds the hand-written kernels behind
+`Config.kernel_backend = "pallas"` plus the quantized sketch-table
+wire transport behind `--sketch_table_dtype`:
+
+  * `sketch_pallas` — fused count-sketch accumulate (hash + signed
+    rotate-add across all r rows in one VMEM pass), fused
+    estimate-all, and the fused estimate+threshold selection that
+    produces the k-sparse server update without materializing the
+    full [D] estimate vector in HBM.
+  * `quant` — bf16/int8 sketch-table wire round-trip (quantize the
+    shard's client-sum table, dequantize before the psum/decode) and
+    the wire-byte math the accountant bills.
+
+Backend selection is STATIC config: `ops/sketch.CSVec` dispatches per
+method on its `backend` field, so a given Config traces exactly the
+same number of programs either way and the `xla` default never
+imports a kernel. Every kernel also runs under
+`pallas_call(interpret=True)` — the automatic non-TPU fallback — so
+the tier-1 CPU suite executes the identical kernel bodies the TPU
+compiles (tests/test_kernels.py, `pallas` marker).
+"""
+from commefficient_tpu.ops.kernels.quant import (  # noqa: F401
+    TABLE_DTYPES, table_elem_bytes, wire_roundtrip,
+)
+from commefficient_tpu.ops.kernels.sketch_pallas import (  # noqa: F401
+    pallas_encode, pallas_estimate_all, pallas_fits,
+    pallas_threshold_decode,
+)

@@ -122,6 +122,18 @@ class CSVec:
     r: int
     num_blocks: int = 1   # accepted for parity; results are invariant
     seed: int = 42
+    # kernel backend for the dense hot-path ops (Config.kernel_backend,
+    # ISSUE 6): "xla" keeps every method on the code below — the
+    # default program is bit-identical to a build without the field —
+    # while "pallas" routes encode / estimate_all / the threshold
+    # decode through the fused kernels in ops/kernels/sketch_pallas
+    # (interpret-mode off TPU, so CPU tests run the kernel bodies).
+    # Geometries past the kernels' VMEM gate (pallas_fits) fall back
+    # to the XLA route per method — static per geometry, so a given
+    # CSVec takes ONE route everywhere. The hash/gather paths
+    # (estimate, encode_sparse) have no kernel: they are the
+    # scatter/gather formulation the kernels exist to avoid.
+    backend: str = "xla"
 
     def __post_init__(self):
         rng = np.random.RandomState(self.seed)
@@ -145,6 +157,14 @@ class CSVec:
     @property
     def _static_path(self) -> bool:
         return self.r * self.n_chunks <= STATIC_UNROLL_LIMIT
+
+    def _pallas(self, kind: str) -> bool:
+        """Whether `kind` ('encode' | 'estimate') runs on the fused
+        Pallas kernel for this sketch (backend field + VMEM gate)."""
+        if self.backend != "pallas":
+            return False
+        from commefficient_tpu.ops.kernels import pallas_fits
+        return pallas_fits(self, kind)
 
     @property
     def table_shape(self) -> Tuple[int, int]:
@@ -191,7 +211,12 @@ class CSVec:
 
         Static-offset unroll (shifts known at trace time -> `jnp.roll`
         lowers to fusible static slices; see module perf notes); scan
-        fallback above STATIC_UNROLL_LIMIT."""
+        fallback above STATIC_UNROLL_LIMIT; the fused Pallas kernel
+        (one VMEM pass per row, hardware dynamic rotate, compile time
+        flat in r * B) replaces BOTH when backend == 'pallas'."""
+        if self._pallas("encode"):
+            from commefficient_tpu.ops.kernels import pallas_encode
+            return pallas_encode(self, vec)
         chunks = self._padded_chunks(vec)                  # [B, c]
         eps = jnp.asarray(self._eps)                       # [r, c]
 
@@ -286,7 +311,14 @@ class CSVec:
         """[B, c] median-of-rows estimates for every coordinate
         (flattened [: d] is the full estimate vector): r inverse
         rotations + sign correction per chunk, no gathers. Static
-        unroll when small enough (module perf notes)."""
+        unroll when small enough (module perf notes); one fused
+        rotate+median kernel pass when backend == 'pallas' (the
+        Pallas route additionally zeroes the padding tail — a
+        superset of this method's contract that every caller
+        re-zeroes anyway)."""
+        if self._pallas("estimate"):
+            from commefficient_tpu.ops.kernels import pallas_estimate_all
+            return pallas_estimate_all(self, table)
         eps = jnp.asarray(self._eps)
 
         if self._static_path:
@@ -345,9 +377,19 @@ class CSVec:
         sampled-threshold route — one approx_max_k over a ~1M sample
         plus one elementwise mask, instead of an index top-k whose TPU
         partial-reduce sort grows with k*d — otherwise identical to
-        decode_topk."""
+        decode_topk. With backend == 'pallas' the threshold route is
+        the FUSED estimate+select kernel pair: the full [D] estimate
+        vector is never materialized in HBM (estimates recompute in
+        VMEM for the sample and the mask pass; kernels module
+        docstring covers the sample-phase difference the selection
+        tolerance already absorbs)."""
         if not self._threshold_decode:
             return self.decode_topk(table, k)
+        if self._pallas("estimate"):
+            from commefficient_tpu.ops.kernels import (
+                pallas_threshold_decode,
+            )
+            return pallas_threshold_decode(self, table, min(k, self.d))
 
         from commefficient_tpu.ops.flat import sampled_threshold_mask
         # the padding tail of _flat_estimates is already zeroed, which

@@ -98,6 +98,11 @@ def attach_run_telemetry(model, cfg, log_dir: str, coord: bool,
         "run_start", driver=driver, mode=cfg.mode,
         dataset=cfg.dataset_name, num_workers=cfg.num_workers,
         num_clients=model.num_clients, grad_size=model.cfg.grad_size,
+        # compression-kernel provenance (ISSUE 6): a journal reader
+        # attributing up_bytes or round timings needs to know which
+        # backend ran and what dtype rode the wire
+        kernel_backend=cfg.kernel_backend,
+        sketch_table_dtype=cfg.sketch_table_dtype,
         scan_rounds=bool(cfg.scan_rounds),
         transfer_guard=bool(cfg.debug_transfer_guard),
         resumed_round=int(np.asarray(

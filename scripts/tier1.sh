@@ -57,5 +57,33 @@ if [ "$rc" -eq 0 ]; then
       || { echo "SCHEDULED_SMOKE_FAILED"; exit 1; }
   python scripts/journal_summary.py "$JR2" \
       || { echo "SCHED_JOURNAL_INVALID"; exit 1; }
+
+  # Pallas kernel-backend gate (ISSUE 6 satellite). Two parts:
+  # (1) the `pallas` marker suite alone — the kernels' interpret-mode
+  #     equivalence/property tests must be green on CPU regardless of
+  #     TPU tunnel state (they also ran inside the main sweep above;
+  #     this dedicated pass keeps the gate visible and cheap to rerun);
+  # (2) a driver smoke on the fused-kernel backend with a bf16 wire
+  #     table (small sketch geometry so the CPU interpreter finishes),
+  #     whose journal must validate — the record format carries the
+  #     corrected wire-dtype byte totals and must not rot.
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+      -m pallas -p no:cacheprovider -p no:xdist -p no:randomly \
+      >/dev/null 2>&1 || { echo "PALLAS_SUITE_FAILED"; exit 1; }
+  JR3=/tmp/_t1_journal_pallas.jsonl
+  rm -f "$JR3"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode sketch \
+      --error_type virtual --virtual_momentum 0.9 \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --k 64 --num_rows 3 --num_cols 256 --num_blocks 1 \
+      --kernel_backend pallas --sketch_table_dtype bf16 \
+      --journal_path "$JR3" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "PALLAS_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR3" \
+      || { echo "PALLAS_JOURNAL_INVALID"; exit 1; }
 fi
 exit $rc

@@ -48,6 +48,31 @@ def test_upload_bytes_per_mode():
     assert up[0] == 4.0 * 21
 
 
+def test_upload_bytes_reflect_wire_dtype():
+    """ISSUE 6 accounting fix: a quantized sketch table is billed at
+    the WIRE element size, not f32 — bf16 halves the bytes, int8
+    quarters them plus the r per-row f32 scales it ships."""
+    base = dict(mode="sketch", num_rows=3, num_cols=7,
+                error_type="virtual", local_momentum=0.0)
+    for dtype, want in [("f32", 4.0 * 21), ("bf16", 2.0 * 21),
+                        ("int8", 1.0 * 21 + 4.0 * 3)]:
+        acct = CommAccountant(
+            cfg_for(sketch_table_dtype=dtype, **base), num_clients=10)
+        _, up = acct.record_round(np.array([0, 4]), None)
+        assert up[0] == up[4] == want, (dtype, up[0], want)
+        assert up[1] == 0
+    # downloads are dense f32 weights regardless of the table dtype:
+    # round 2's download charge is unchanged by quantized uploads
+    acct = CommAccountant(
+        cfg_for(sketch_table_dtype="int8", grad_size=64, **base),
+        num_clients=10)
+    acct.record_round(np.array([0]), None)
+    bits = np.asarray(pack_change_bits(
+        jnp.zeros(64).at[jnp.array([1, 2, 3])].set(1.0)))
+    down, _ = acct.record_round(np.array([0]), bits)
+    assert down[0] == 4.0 * 3
+
+
 def test_download_first_round_free():
     acct = CommAccountant(cfg_for(), num_clients=4)
     down, _ = acct.record_round(np.array([0, 1]), None)

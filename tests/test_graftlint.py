@@ -172,6 +172,33 @@ GL007_NEG = """
         return mapped, jitted, fwd, pos, pos_jit
 """
 
+GL008_POS = """
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def decode(est):
+        vals, idx = lax.top_k(est, 50000)
+        also = jax.lax.top_k(est * est, k=65536)
+        return vals, idx, also
+"""
+GL008_NEG = """
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def decode(est, k):
+        small = lax.top_k(est, 16)                 # small static k: fine
+        approx = jax.lax.approx_max_k(est, 50000)  # the blessed route
+        dyn = lax.top_k(est, k)                    # non-constant k: invisible
+        other = est.top_k(50000)                   # not jax.lax's
+        return small, approx, dyn, other
+
+    def host_side(est):
+        # outside traced code: not this rule's business
+        return lax.top_k(est, 50000)
+"""
+
 FIXTURES = {
     "GL001": (GL001_POS, GL001_NEG),
     "GL002": (GL002_POS, GL002_NEG),
@@ -180,6 +207,7 @@ FIXTURES = {
     "GL005": (GL005_POS, GL005_NEG),
     "GL006": (GL006_POS, GL006_NEG),
     "GL007": (GL007_POS, GL007_NEG),
+    "GL008": (GL008_POS, GL008_NEG),
 }
 
 
