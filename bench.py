@@ -270,6 +270,9 @@ def main() -> int:
         # f32 master weights); the baseline stand-in stays f32 either
         # way, since the reference's CUDA path is fp32-only
         do_bf16=os.environ.get("BENCH_BF16", "") == "1",
+        # timing loops re-dispatch from ONE retained (server, clients)
+        # — donation would delete those operands on the first call
+        donate_round_state=False,
     ).validate()
 
     loss_fn = ce_loss_fn(model)

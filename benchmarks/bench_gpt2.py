@@ -96,6 +96,8 @@ def main() -> int:
         local_momentum=0.0, weight_decay=0.0, microbatch_size=-1,
         num_workers=NUM_WORKERS, num_clients=10 * NUM_WORKERS,
         grad_size=D, lm_coef=1.0, mc_coef=1.0,
+        # timing loops re-dispatch from one retained (server, clients)
+        donate_round_state=False,
     ).validate()
 
     loss_fn = make_compute_loss_train(module, cfg)

@@ -91,6 +91,8 @@ def main() -> int:
         num_workers=NUM_WORKERS, num_clients=NUM_WORKERS,
         local_batch_size=batch, max_local_batch=batch,
         grad_size=D,
+        # timing loops re-dispatch from one retained (server, clients)
+        donate_round_state=False,
     ).validate()
 
     loss_fn = bench.ce_loss_fn(model)

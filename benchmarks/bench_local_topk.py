@@ -91,6 +91,8 @@ def main() -> int:
         weight_decay=5e-4, microbatch_size=-1, num_workers=NUM_WORKERS,
         num_clients=num_clients, local_batch_size=LOCAL_BATCH,
         grad_size=D,
+        # timing loops re-dispatch from one retained (server, clients)
+        donate_round_state=False,
     ).validate()
 
     loss_fn = bench.ce_loss_fn(model_mod)

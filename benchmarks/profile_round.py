@@ -128,6 +128,9 @@ def main():
         error_type="virtual", virtual_momentum=0.9, local_momentum=0.0,
         weight_decay=5e-4, microbatch_size=-1, num_workers=NUM_WORKERS,
         num_clients=10 * NUM_WORKERS, grad_size=D,
+        # stage timing re-dispatches from one retained state object —
+        # donation would delete it after the first call
+        donate_round_state=False,
     ).validate()
     sketch = CSVec(d=D, c=cfg.num_cols, r=cfg.num_rows,
                    num_blocks=cfg.num_blocks, seed=42)

@@ -9,18 +9,32 @@ mechanical:
 
   * `engine` + `rules` — an AST lint pass (``python -m
     commefficient_tpu.analysis <paths>``) with JAX-specific rules
-    GL001-GL006: host nondeterminism reachable from traced code, hidden
+    GL001-GL009: host nondeterminism reachable from traced code, hidden
     host syncs / trace breaks, PRNG key reuse, Python control flow over
-    traced values, fault-swallowing broad ``except`` handlers, and
-    non-atomic file writes. Per-line ``# graftlint: disable=GLxxx``
-    suppressions and a baseline file grandfather justified hits.
+    traced values, fault-swallowing broad ``except`` handlers,
+    non-atomic file writes, unconstrained shard_map/pjit layouts,
+    large exact top-k, and PRNG domain tags outside the `domains`
+    registry. Per-line ``# graftlint: disable=GLxxx`` suppressions and
+    a baseline file grandfather justified hits.
+  * `audit` + `costmodel` — the SECOND tier (``graftaudit``, ISSUE 7):
+    traces the three round programs per config/backend to ClosedJaxprs
+    and walks the program itself — forbidden host-interaction
+    primitives, f64, large exact sorts, population-scaling buffers
+    (with the named client-state inventory), buffer-donation coverage,
+    and a static FLOPs/HBM cost report gated against the committed
+    ``audit.baseline.json``.
+  * `domains` — the central PRNG-domain registry (dropout / straggler
+    / sampler stream tags) whose uniqueness GL009 and an import-time
+    assert both enforce.
   * `runtime` — sanitizers armed by tests: ``assert_program_count(n)``
     (a compilation counter enforcing the three-programs contract) and
     ``forbid_transfers()`` (``jax.transfer_guard`` proving the jitted
     round performs zero implicit host transfers).
 
-The static pass is deliberately jax-free (pure ``ast``) so it runs in
-any environment — only `runtime` imports jax.
+The lint pass is deliberately jax-free (pure ``ast``) so it runs in
+any environment — only `runtime` and `audit`'s tracing functions
+import jax (lazily, with JAX_PLATFORMS pinned to cpu in the CLI so
+the auditor never claims an accelerator).
 """
 from commefficient_tpu.analysis.engine import (  # noqa: F401
     Baseline, LintError, Violation, lint_paths, lint_source,

@@ -8,56 +8,21 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 from typing import Optional
 
 from commefficient_tpu.analysis.engine import (
-    Baseline, LintError, lint_paths,
+    Baseline, LintError, lint_paths, load_pyproject_tool,
 )
 from commefficient_tpu.analysis.rules import RULE_DOCS
 
 
-def _load_pyproject_config(start: str = ".") -> dict:
-    """[tool.graftlint] from the nearest pyproject.toml, via tomllib/
-    tomli when available, else a minimal line parser good enough for
-    the flat strings-and-string-lists section this tool defines."""
-    path = os.path.join(start, "pyproject.toml")
-    if not os.path.exists(path):
-        return {}
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    try:
-        try:
-            import tomllib  # py311+
-        except ImportError:
-            import tomli as tomllib
-        return tomllib.loads(text).get("tool", {}).get("graftlint", {})
-    except ImportError:
-        pass
-    m = re.search(r"^\[tool\.graftlint\]\s*$(.*?)(?=^\[|\Z)", text,
-                  re.M | re.S)
-    if not m:
-        return {}
-    out: dict = {}
-    for line in m.group(1).splitlines():
-        kv = re.match(r"\s*(\w+)\s*=\s*(.+?)\s*$", line)
-        if not kv:
-            continue
-        key, val = kv.group(1), kv.group(2)
-        if val.startswith("["):
-            out[key] = re.findall(r'"([^"]*)"', val)
-        elif val.startswith('"'):
-            out[key] = val.strip('"')
-    return out
-
-
 def main(argv: Optional[list] = None) -> int:
-    conf = _load_pyproject_config()
+    conf = load_pyproject_tool("graftlint")
     ap = argparse.ArgumentParser(
         prog="graftlint",
         description="trace-safety static analysis for the round engine "
-                    "(rules GL001-GL006; see --list-rules)")
+                    "(rules GL001-GL009; see --list-rules)")
     ap.add_argument("paths", nargs="*",
                     default=conf.get("paths", ["commefficient_tpu"]),
                     help="files/directories to lint")

@@ -1,0 +1,293 @@
+"""Static per-primitive cost model over jaxprs: FLOPs + HBM bytes.
+
+The hardware-independent half of the PERF story (ISSUE 7): PR 6's
+kernel/perf claims are TPU-pending because the tunnel is down, but the
+PROGRAM is fully known at trace time — so this module walks a
+ClosedJaxpr and prices every equation with a deterministic analytic
+model. The absolute numbers are coarse (see the honesty notes below);
+what the auditor gates on is their STABILITY: the same config must
+price to the identical integer on every trace, so any drift in the
+committed `audit.baseline.json` is a real program change someone must
+look at — the static stand-in for a bench regression gate.
+
+Model (deliberately simple, deliberately documented):
+
+  * FLOPs — `dot_general` and `conv_general_dilated` get the exact
+    2·M·N·K count from their dimension numbers; `sort`/`top_k` are
+    priced as comparison networks (n·ceil(log2 n), n·ceil(log2 k));
+    reductions cost their operand size; everything else costs its
+    output size (one op per output element — transcendentals are
+    undercounted by a small constant factor, uniformly, which cancels
+    in a regression diff).
+  * HBM bytes — every equation is priced as if un-fused: operand bytes
+    in + result bytes out. Real XLA fuses elementwise chains, so this
+    is an UPPER BOUND on traffic, not a prediction — but a new
+    intermediate buffer shows up in it immediately, which is the
+    regression class (an accidental [D]-materialization) the gate
+    exists to catch.
+  * Containers — `pjit`/`closed_call`/`remat`/`custom_*` recurse at
+    cost ×1; `scan` multiplies its body by the trip count; `cond`
+    prices the most expensive branch; `while` prices ONE iteration
+    (trip count is dynamic — flagged in the report via `dynamic_loops`
+    so a reader knows the total is a per-iteration figure there);
+    `pallas_call` multiplies its kernel body by the grid size;
+    `shard_map` prices the PER-SHARD program (wall-clock view: shards
+    run in parallel).
+
+Deliberately dependency-light: operates on jaxpr objects by duck
+typing (`.eqns`, `.jaxpr`, avals with `.shape`/`.dtype`), imports
+nothing from jax — so it loads anywhere and survives jax-internal
+module moves.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# primitives priced as pure data movement (FLOPs 0): layout, slicing,
+# indexing, conversion-free reshapes
+_DATA_MOVEMENT = frozenset({
+    "reshape", "broadcast_in_dim", "squeeze", "transpose", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "gather", "scatter", "rev", "copy", "convert_element_type",
+    "bitcast_convert_type", "device_put", "iota", "roll",
+    "random_wrap", "random_unwrap", "stop_gradient", "split",
+    "program_id", "get", "swap",
+})
+
+# reductions: one op per OPERAND element
+_REDUCERS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+    "cumsum", "cumprod", "cummax", "cummin", "reduce_precision",
+    "psum", "pmax", "pmin", "all_gather", "reduce_scatter",
+})
+
+# container primitives whose cost is their inner jaxpr's, with a
+# multiplier; the eqn itself moves no bytes beyond what the body does
+_CONTAINERS = frozenset({
+    "pjit", "closed_call", "core_call", "xla_call", "remat", "remat2",
+    "checkpoint", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr", "scan", "while",
+    "cond", "shard_map", "pallas_call", "custom_partitioning",
+})
+
+
+class Cost:
+    """Mutable accumulator: total flops/bytes + per-primitive rollup."""
+
+    def __init__(self):
+        self.flops = 0
+        self.hbm_bytes = 0
+        self.eqns = 0
+        self.dynamic_loops = 0
+        self.by_primitive: Dict[str, Dict[str, int]] = {}
+
+    def add(self, prim: str, flops: int, hbm_bytes: int,
+            mult: int = 1) -> None:
+        flops, hbm_bytes = int(flops) * mult, int(hbm_bytes) * mult
+        self.flops += flops
+        self.hbm_bytes += hbm_bytes
+        self.eqns += 1
+        row = self.by_primitive.setdefault(
+            prim, {"count": 0, "flops": 0, "hbm_bytes": 0})
+        row["count"] += 1
+        row["flops"] += flops
+        row["hbm_bytes"] += hbm_bytes
+
+    def merge(self, other: "Cost", mult: int = 1) -> None:
+        self.flops += other.flops * mult
+        self.hbm_bytes += other.hbm_bytes * mult
+        self.eqns += other.eqns
+        self.dynamic_loops += other.dynamic_loops
+        for prim, row in other.by_primitive.items():
+            mine = self.by_primitive.setdefault(
+                prim, {"count": 0, "flops": 0, "hbm_bytes": 0})
+            mine["count"] += row["count"]
+            mine["flops"] += row["flops"] * mult
+            mine["hbm_bytes"] += row["hbm_bytes"] * mult
+
+    def as_dict(self, top: int = 8) -> dict:
+        """Canonical JSON-able report; `by_primitive` keeps the `top`
+        most expensive primitives by FLOPs (ties broken by name so the
+        report is bit-stable), plus an `other` rollup."""
+        rows = sorted(self.by_primitive.items(),
+                      key=lambda kv: (-kv[1]["flops"],
+                                      -kv[1]["hbm_bytes"], kv[0]))
+        head = {k: dict(v) for k, v in rows[:top]}
+        tail = rows[top:]
+        if tail:
+            head["other"] = {
+                "count": sum(v["count"] for _, v in tail),
+                "flops": sum(v["flops"] for _, v in tail),
+                "hbm_bytes": sum(v["hbm_bytes"] for _, v in tail),
+            }
+        return {
+            "flops": self.flops,
+            "hbm_bytes": self.hbm_bytes,
+            "eqns": self.eqns,
+            "dynamic_loops": self.dynamic_loops,
+            "by_primitive": head,
+        }
+
+
+def aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return _numel(shape) * int(getattr(dtype, "itemsize", 4))
+
+
+def _numel(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _out_numel(eqn) -> int:
+    return sum(_numel(getattr(v.aval, "shape", ()))
+               for v in eqn.outvars)
+
+
+def _operand_avals(eqn):
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and getattr(aval, "shape", None) is not None:
+            yield aval
+
+
+def _eqn_bytes(eqn) -> int:
+    return (sum(aval_bytes(a) for a in _operand_avals(eqn))
+            + sum(aval_bytes(v.aval) for v in eqn.outvars))
+
+
+def _dot_flops(eqn) -> int:
+    (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = [a.shape for a in _operand_avals(eqn)][:2]
+    k = _numel([lhs[i] for i in lc])
+    b = _numel([lhs[i] for i in lb])
+    m = _numel([d for i, d in enumerate(lhs)
+                if i not in set(lc) | set(lb)])
+    n_contract = set(rc)
+    n_batch = set(_rb)
+    n = _numel([d for i, d in enumerate(rhs)
+                if i not in n_contract | n_batch])
+    return 2 * b * m * n * k
+
+
+def _conv_flops(eqn) -> int:
+    dn = eqn.params["dimension_numbers"]
+    rhs_spec = getattr(dn, "rhs_spec", None)
+    avals = list(_operand_avals(eqn))
+    rhs = avals[1].shape if len(avals) > 1 else ()
+    out = _out_numel(eqn)
+    if rhs_spec is None or not rhs:
+        return 2 * out
+    out_feature_dim = rhs_spec[0]
+    k_prod = _numel(rhs) // max(int(rhs[out_feature_dim]), 1)
+    groups = int(eqn.params.get("feature_group_count", 1) or 1)
+    return 2 * out * (k_prod // max(groups, 1))
+
+
+def _log2ceil(n: int) -> int:
+    return max(1, math.ceil(math.log2(max(int(n), 2))))
+
+
+def sort_width(eqn) -> int:
+    """Length of the dimension a `sort` eqn actually sorts along —
+    the cost driver. `jnp.median(table, axis=0)` sorts a [5, 500000]
+    operand along dimension 0: half a million independent 5-wide
+    sorts, nothing like a 500000-wide sorting network; pricing (or
+    flagging, audit AU003) by the trailing dim would be wrong by 5e5."""
+    shapes = [a.shape for a in _operand_avals(eqn) if a.shape]
+    if not shapes:
+        return 2
+    dim = eqn.params.get("dimension")
+    if dim is None:
+        dim = len(shapes[0]) - 1
+    return int(shapes[0][dim])
+
+
+def sub_jaxprs(value) -> Iterable:
+    """Jaxpr-like objects inside one eqn param value (ClosedJaxpr has
+    `.jaxpr.eqns`, raw Jaxpr has `.eqns`), by duck typing."""
+    vals = value if isinstance(value, (list, tuple)) else [value]
+    for v in vals:
+        inner = getattr(v, "jaxpr", None)
+        if inner is not None and hasattr(inner, "eqns"):
+            yield inner
+        elif hasattr(v, "eqns"):
+            yield v
+
+
+def _container_multiplier(eqn) -> int:
+    name = eqn.primitive.name
+    if name == "scan":
+        return max(int(eqn.params.get("length", 1) or 1), 1)
+    if name == "pallas_call":
+        gm = eqn.params.get("grid_mapping")
+        grid = getattr(gm, "grid", None) if gm is not None else None
+        if grid is None:
+            grid = eqn.params.get("grid", ())
+        try:
+            return max(_numel(grid), 1)
+        except (TypeError, ValueError):
+            return 1
+    return 1
+
+
+def jaxpr_cost(jaxpr) -> Cost:
+    """Price one jaxpr (Closed or raw), recursively."""
+    inner = getattr(jaxpr, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        jaxpr = inner
+    cost = Cost()
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _CONTAINERS or any(
+                True for v in eqn.params.values()
+                for _ in sub_jaxprs(v)):
+            mult = _container_multiplier(eqn)
+            if name == "while":
+                cost.dynamic_loops += 1
+            subs = [s for v in eqn.params.values()
+                    for s in sub_jaxprs(v)]
+            if name == "cond":
+                # price the most expensive branch (the dispatched
+                # round takes one; max is the conservative choice)
+                branch_costs = [jaxpr_cost(s) for s in subs]
+                if branch_costs:
+                    cost.merge(max(branch_costs,
+                                   key=lambda c: (c.flops,
+                                                  c.hbm_bytes)), mult)
+            else:
+                for s in subs:
+                    cost.merge(jaxpr_cost(s), mult)
+            continue
+        if name in ("dot_general",):
+            cost.add(name, _dot_flops(eqn), _eqn_bytes(eqn))
+        elif name == "conv_general_dilated":
+            cost.add(name, _conv_flops(eqn), _eqn_bytes(eqn))
+        elif name == "sort":
+            n = max((_numel(a.shape) for a in _operand_avals(eqn)),
+                    default=0)
+            cost.add(name, n * _log2ceil(sort_width(eqn)),
+                     _eqn_bytes(eqn))
+        elif name in ("top_k", "approx_top_k"):
+            n = max((_numel(a.shape) for a in _operand_avals(eqn)),
+                    default=0)
+            k = int(eqn.params.get("k",
+                                   eqn.params.get("reduction_input_size_override",
+                                                  2)) or 2)
+            cost.add(name, n * _log2ceil(abs(k)), _eqn_bytes(eqn))
+        elif name in _REDUCERS:
+            n = sum(_numel(a.shape) for a in _operand_avals(eqn))
+            cost.add(name, n, _eqn_bytes(eqn))
+        elif name in _DATA_MOVEMENT:
+            cost.add(name, 0, _eqn_bytes(eqn))
+        else:
+            # elementwise default: one op per output element
+            cost.add(name, _out_numel(eqn), _eqn_bytes(eqn))
+    return cost

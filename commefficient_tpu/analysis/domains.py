@@ -1,0 +1,56 @@
+"""Central PRNG-domain registry: every counter-based draw's domain tag.
+
+The engine's determinism story rests on DOMAIN SEPARATION: the dropout,
+straggler, and scheduler draws are each a pure function of
+``(seed, domain, round_idx)`` on a counter-based generator, so the
+three streams never alias each other and a resumed run replays all of
+them bit-exactly (utils/faults, scheduler/policy). That only holds
+while the domain tags stay DISTINCT — a collision silently correlates
+two "independent" failure processes, the exact class of bug that is
+invisible at runtime and catastrophic in a convergence study.
+
+Before this registry the tags lived as inline hex literals in the
+modules that drew from them; nothing enforced uniqueness, and a new
+subsystem picking a tag had to grep for collisions by hand. Now:
+
+  * every domain constant lives HERE, keyed by a name that documents
+    its consumer;
+  * uniqueness is asserted at import time (and, pure-AST, by graftlint
+    rule GL009, which also flags inline hex literals inside
+    ``fold_in``/``SeedSequence`` calls anywhere in the tree — new
+    draws must route through this registry);
+  * consumers import the tag by name, so the registry is the single
+    place a reviewer audits the stream layout.
+
+Deliberately dependency-free (stdlib only): `utils/faults` and
+`scheduler/policy` import this at module load, and graftlint parses it
+without executing anything.
+"""
+from __future__ import annotations
+
+# name -> domain tag. Tags are arbitrary distinct integers; the hex
+# spellings are mnemonic ("0D120" ~ Dropout, "51044" ~ SLOw, "5C4ED" ~
+# SChED) and FROZEN — changing a value changes every historical run's
+# fault/schedule replay, so tags may be added but never edited.
+DOMAINS = {
+    "dropout": 0x0D120,    # utils/faults.bernoulli_survivors
+    "straggler": 0x51044,  # utils/faults.straggler_work_fractions
+    "sampler": 0x5C4ED,    # scheduler/policy.ThroughputAwareSampler
+}
+
+_values = list(DOMAINS.values())
+assert len(set(_values)) == len(_values), (
+    "PRNG domain collision in analysis/domains.DOMAINS: two streams "
+    "sharing a tag are perfectly correlated")
+
+
+def domain(name: str) -> int:
+    """The registered domain tag for `name`; KeyError (with the known
+    names listed) on a typo rather than a silent new stream."""
+    try:
+        return DOMAINS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown PRNG domain {name!r}; registered: "
+            f"{sorted(DOMAINS)} (add new streams to analysis/domains)"
+        ) from None

@@ -28,6 +28,46 @@ _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
 
 
+def load_pyproject_tool(tool: str, start: str = ".") -> dict:
+    """``[tool.<tool>]`` from the nearest pyproject.toml — shared by
+    the graftlint and graftaudit CLIs. Via tomllib/tomli when
+    available, else a minimal line parser good enough for the flat
+    strings / string-lists / numbers these tools define."""
+    path = os.path.join(start, "pyproject.toml")
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        try:
+            import tomllib  # py311+
+        except ImportError:
+            import tomli as tomllib
+        return tomllib.loads(text).get("tool", {}).get(tool, {})
+    except ImportError:
+        pass
+    m = re.search(r"^\[tool\.%s\]\s*$(.*?)(?=^\[|\Z)" % re.escape(tool),
+                  text, re.M | re.S)
+    if not m:
+        return {}
+    out: dict = {}
+    for line in m.group(1).splitlines():
+        kv = re.match(r"\s*(\w+)\s*=\s*(.+?)\s*$", line)
+        if not kv:
+            continue
+        key, val = kv.group(1), kv.group(2)
+        if val.startswith("["):
+            out[key] = re.findall(r'"([^"]*)"', val)
+        elif val.startswith('"'):
+            out[key] = val.strip('"')
+        else:
+            try:
+                out[key] = float(val) if "." in val else int(val)
+            except ValueError:
+                pass
+    return out
+
+
 @dataclasses.dataclass(frozen=True, order=True)
 class Violation:
     path: str

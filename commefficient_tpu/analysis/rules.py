@@ -1,4 +1,4 @@
-"""graftlint rules GL001-GL008.
+"""graftlint rules GL001-GL009.
 
 Each rule is a function ``check(module: ModuleInfo) -> Iterator[
 Violation]`` over one parsed file. The rules are deliberately
@@ -25,6 +25,7 @@ exactly what makes the lexical rule strong here.
 from __future__ import annotations
 
 import ast
+import os
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from commefficient_tpu.analysis.engine import Violation
@@ -602,6 +603,79 @@ def check_gl008(module: ModuleInfo) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GL009 — PRNG-domain constants outside the central registry
+
+# The engine's deterministic-replay story separates the dropout /
+# straggler / scheduler streams by counter-based domain tags. Those
+# tags live in analysis/domains.DOMAINS — the ONE place uniqueness is
+# asserted. This rule holds the line syntactically: an inline hex
+# literal fed to `fold_in` / `SeedSequence` is a domain tag that
+# bypassed the registry (invisible to the collision assert), and a
+# duplicate value inside the registry dict itself is a collision. Both
+# apply file-wide, not just in traced scope: the production draws
+# (utils/faults, scheduler/policy) are deliberately host-side.
+
+_GL009_SINKS = frozenset({"fold_in", "SeedSequence"})
+_GL009_REGISTRY_SUFFIX = "analysis/domains.py"
+
+
+def _is_hex_literal(module: ModuleInfo, node: ast.AST) -> bool:
+    if not (isinstance(node, ast.Constant) and isinstance(node.value, int)
+            and not isinstance(node.value, bool)):
+        return False
+    return module.segment(node).strip().lower().startswith("0x")
+
+
+def check_gl009(module: ModuleInfo) -> Iterator[Violation]:
+    # (a) inline hex domain tags at a key-derivation sink, at any
+    # argument depth (SeedSequence takes its entropy as a list)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal(_dotted(node.func)) not in _GL009_SINKS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if _is_hex_literal(module, sub):
+                    yield Violation(
+                        module.path, sub.lineno, sub.col_offset, "GL009",
+                        f"inline hex domain tag `{module.segment(sub)}` "
+                        "in a PRNG key derivation: domain constants "
+                        "must come from analysis/domains.DOMAINS (the "
+                        "registry asserts stream uniqueness; an inline "
+                        "tag can silently collide with an existing "
+                        "stream)")
+    # (b) collisions inside the registry itself (pure AST — graftlint
+    # never executes the tree, so the import-time assert is re-proven
+    # syntactically on the literal dict)
+    if not module.path.replace(os.sep, "/").endswith(
+            _GL009_REGISTRY_SUFFIX):
+        return
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "DOMAINS"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        seen: Dict[int, str] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)):
+                continue
+            name = (k.value if isinstance(k, ast.Constant) else
+                    module.segment(k))
+            if v.value in seen:
+                yield Violation(
+                    module.path, v.lineno, v.col_offset, "GL009",
+                    f"PRNG domain collision: {name!r} reuses tag "
+                    f"{hex(v.value)} already registered to "
+                    f"{seen[v.value]!r} — correlated streams break the "
+                    "independent-failure-process model")
+            else:
+                seen[v.value] = name
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "GL001": check_gl001,
@@ -612,6 +686,7 @@ ALL_RULES = {
     "GL006": check_gl006,
     "GL007": check_gl007,
     "GL008": check_gl008,
+    "GL009": check_gl009,
 }
 
 RULE_DOCS = {
@@ -631,4 +706,7 @@ RULE_DOCS = {
     "GL008": "exact lax.top_k with large static k in traced code "
              "(TPU sorting-network cliff; use approx_max_k or the "
              "fused selection kernel)",
+    "GL009": "PRNG domain tag outside the analysis/domains registry "
+             "(inline hex in fold_in/SeedSequence, or a registry "
+             "collision)",
 }

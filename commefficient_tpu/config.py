@@ -155,6 +155,27 @@ class Config:
     # machinery costs nothing when disabled. Tests inject explicit
     # per-round schedules instead (utils/faults.FaultSchedule).
     client_dropout: float = 0.0
+    # buffer donation for the jitted round dispatch (ISSUE 7: the
+    # graftaudit donation audit's first applied finding). When on —
+    # the default — the dead-after-dispatch round inputs are donated
+    # to XLA so their HBM is reused for the matching outputs in place:
+    # the scanned span donates ServerState AND the per-client state
+    # rows (run_rounds only ever assigns state from the span's
+    # RESULT), the per-round path donates the client rows only
+    # (FedModel._call_train reads the previous ps_weights AFTER
+    # dispatch for the lagged accounting bitset, so ServerState must
+    # survive — the justified exception graftaudit documents). At the
+    # EMNIST/PERSONA populations the error-feedback block is the
+    # dominant allocation (3500 x 6.6M f32 ≈ 92 GB across a pod), so
+    # un-donated dispatch transiently doubles it. Semantics are
+    # bit-identical either way (aliasing only; tests/test_audit.py
+    # proves resume bit-exactness) — but donated inputs are INVALID
+    # after the call: generic callers that re-dispatch from a retained
+    # state object (benchmark timing loops) must disable this, and a
+    # donated span dispatch that fails mid-execute can no longer be
+    # transparently retried (utils/retry), which is what
+    # --no_donate_round_state is for on flaky preemptible pods.
+    donate_round_state: bool = True
     # straggler (slow-client) modeling beyond binary dropout: each
     # sampled client is a straggler with probability straggler_rate;
     # a straggler draws a WORK FRACTION uniform in
@@ -623,6 +644,16 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                    help="per-round probability a sampled client fails "
                         "to complete the round (survivor-reweighted "
                         "aggregation; Config.client_dropout)")
+    p.add_argument("--no_donate_round_state", action="store_false",
+                   dest="donate_round_state",
+                   help="disable buffer donation of dead-after-"
+                        "dispatch round state (donation is ON by "
+                        "default: in-place HBM reuse of the server/"
+                        "client state blocks, bit-identical results; "
+                        "disable for callers that re-dispatch from a "
+                        "retained state object or need failed span "
+                        "dispatches to stay retryable — "
+                        "Config.donate_round_state)")
     p.add_argument("--straggler_rate", type=float, default=0.0,
                    help="per-round probability a sampled client is a "
                         "straggler completing only a fraction of its "

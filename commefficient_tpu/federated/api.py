@@ -251,6 +251,37 @@ class FedModel:
         progress past the crash."""
         self.fault_schedule = schedule
 
+    def trace_round_programs(self, batch) -> dict:
+        """{variant: ClosedJaxpr} of the three single-round programs
+        THIS model dispatches — the graftaudit (analysis/audit) hook
+        for auditing a real workload rather than the CLI's synthetic
+        one. `batch` is a (client_ids, data, mask) triple exactly as
+        `model(batch)` takes it; only its shapes/dtypes matter (the
+        trace is abstract — nothing executes, no state moves). The
+        traced body is `round.make_train_fn`'s round_step, i.e. the
+        same program the per-round jit AND each scanned-span step
+        compile, so what the auditor walks is what run_rounds
+        dispatches."""
+        from commefficient_tpu.federated.round import (
+            audit_batch_variants,
+        )
+        client_ids, data, mask = batch
+        rb = fround.RoundBatch(
+            jnp.asarray(np.asarray(client_ids, np.int32)),
+            tuple(jnp.asarray(d) for d in data),
+            jnp.asarray(np.asarray(mask, np.float32)))
+        # the lr operand must have the DISPATCHED aval: with a
+        # per-parameter scale vector _lr() ships a [D] f32 array, and
+        # auditing a scalar-lr program instead would walk a program
+        # this model never runs
+        lr = (jnp.asarray(0.1 * self.lr_scale_vec)
+              if self.lr_scale_vec is not None else jnp.float32(0.1))
+        out = {}
+        for variant, vb in audit_batch_variants(rb).items():
+            out[variant] = jax.make_jaxpr(self._train_round.round_step)(
+                self.server, self.clients, vb, lr, self._key)
+        return out
+
     @property
     def checkpoint_fingerprint(self) -> dict:
         """The config-compatibility fingerprint checkpoints written by
@@ -459,6 +490,14 @@ class FedModel:
         multihost.local_row_slice): per-process batch feeding — no host
         materializes the global batch."""
         client_ids, data, mask = batch
+        # donation contract (Config.donate_round_state): the per-round
+        # jit donates the ClientState operand — self.clients is
+        # reassigned from the result below and never read in between.
+        # ServerState is deliberately NOT donated on this path: the
+        # prev_weights reference captured here is read AFTER dispatch
+        # for the one-round-lagged accounting bitset, and a donated
+        # ps_weights would be a deleted buffer by then
+        # (round.ROUND_DEAD_ARGNUMS is the authoritative declaration).
         prev_weights = self.server.ps_weights
 
         this_round = self._rounds_done
@@ -621,6 +660,16 @@ class FedModel:
         # its result — so a transient runtime failure (coordinator
         # blip on a preemptible pod) can safely be retried without
         # half-mutated state; fatal errors re-raise immediately.
+        # Donation caveat (Config.donate_round_state, default on): the
+        # span jit donates BOTH state operands (run_rounds reads
+        # nothing after dispatch — even the change bitset comes from
+        # the span's result), so a failure DURING execution leaves
+        # them deleted and the retry surfaces a fatal
+        # array-deleted error instead of replaying; failures in the
+        # staging/globalize phase (where coordinator blips actually
+        # land) retry as before. --no_donate_round_state restores full
+        # span retryability at the cost of transiently doubled state
+        # HBM.
         def dispatch():
             return self._train_round.train_rounds(
                 self.server, self.clients,

@@ -150,8 +150,12 @@ def init_client_state(cfg: Config, num_clients: int,
 
         # even the zero-size placeholders must be global arrays in a
         # multi-controller run (every jit operand needs a sharding on
-        # the global mesh)
-        empty = mh.zeros(mesh, P(), (0,))
+        # the global mesh). One DISTINCT buffer per placeholder field:
+        # donation (Config.donate_round_state) marks every leaf of the
+        # client-state operand donatable, and XLA rejects the same
+        # buffer donated twice.
+        def empty():
+            return mh.zeros(mesh, P(), (0,))
 
         def alloc(shape):
             # global sharded allocation: shard-local zeros only — in a
@@ -159,14 +163,15 @@ def init_client_state(cfg: Config, num_clients: int,
             # [num_clients, D] block
             return mh.zeros(mesh, P("clients", None), shape)
     else:
-        empty = jnp.zeros((0,), jnp.float32)
+        def empty():
+            return jnp.zeros((0,), jnp.float32)
 
         def alloc(shape):
             return jnp.zeros(shape, jnp.float32)
 
-    errors = alloc((rows, D)) if cfg.error_type == "local" else empty
+    errors = alloc((rows, D)) if cfg.error_type == "local" else empty()
     velocities = (alloc((rows, D)) if cfg.local_momentum > 0
-                  else empty)
+                  else empty())
     if cfg.do_topk_down:
         assert ps_weights is not None
         if mesh is not None:
@@ -174,12 +179,61 @@ def init_client_state(cfg: Config, num_clients: int,
         else:
             weights = jnp.broadcast_to(ps_weights, (rows, D)).copy()
     else:
-        weights = empty
+        weights = empty()
     return ClientState(errors, velocities, weights)
 
 
 def _has_errors(cfg): return cfg.error_type == "local"
 def _has_velocities(cfg): return cfg.local_momentum > 0
+
+
+# ---------------------------------------------------------------------------
+# program registry: the contract surface graftaudit (analysis/audit)
+# traces and checks. Exactly three single-round programs exist per
+# config — one per RoundBatch treedef — and the two dispatch entry
+# points declare which of their inputs are DEAD after dispatch (safe
+# to donate: the caller never reads them again).
+
+# the three traced round programs, in the order the fault machinery
+# grows them (ROADMAP invariant; analysis/runtime.assert_program_count
+# proves the count dynamically, graftaudit walks each one statically)
+PROGRAM_VARIANTS = ("mask_free", "dropout", "dropout_stragglers")
+
+# per-round dispatch (TrainRound.__call__): ClientState is dead — the
+# caller (FedModel._call_train, every test) reassigns it from the
+# result — but ServerState is NOT: _call_train reads the previous
+# ps_weights AFTER dispatch for the one-round-lagged accounting bitset,
+# so donating it would hand accounting a deleted buffer. graftaudit's
+# donation audit uses exactly this declaration.
+ROUND_DEAD_ARGNUMS = (1,)
+# scanned-span dispatch (TrainRound.train_rounds): both state operands
+# are dead — run_rounds computes the change bitset INSIDE the span and
+# assigns all state from the result.
+SPAN_DEAD_ARGNUMS = (0, 1)
+
+
+def program_variant(batch: RoundBatch) -> str:
+    """Which of the three traced programs `batch`'s treedef selects."""
+    if batch.work is not None:
+        return "dropout_stragglers"
+    if batch.survivors is not None:
+        return "dropout"
+    return "mask_free"
+
+
+def audit_batch_variants(batch: RoundBatch) -> dict:
+    """The three RoundBatch treedef variants derived from one concrete
+    batch — the exact programs a run with dropout/stragglers enabled
+    dispatches. Survivor/work operands are inert values (all-survive,
+    half-work) chosen only to pin the treedef; graftaudit traces each
+    variant abstractly so the values never execute."""
+    ones = jnp.ones(batch.client_ids.shape[0], jnp.float32)
+    return {
+        "mask_free": batch._replace(survivors=None, work=None),
+        "dropout": batch._replace(survivors=ones, work=None),
+        "dropout_stragglers": batch._replace(survivors=ones,
+                                             work=ones * 0.5),
+    }
 
 
 def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
@@ -537,10 +591,19 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         return new_server, new_clients, RoundMetrics(
             losses, metrics, counts, tele)
 
-    _train_round_jit = jax.jit(round_step)
+    # buffer donation (Config.donate_round_state, default on): the
+    # dead-after-dispatch state operands are donated so XLA reuses
+    # their HBM for the matching outputs in place — at population
+    # scale the client rows are the dominant allocation, and an
+    # un-donated round transiently doubles it. The dead sets are the
+    # registry constants above; donated operands are INVALID after the
+    # call (see TrainRound docstring for the caller contract).
+    round_donate = (ROUND_DEAD_ARGNUMS if cfg.donate_round_state
+                    else ())
+    span_donate = SPAN_DEAD_ARGNUMS if cfg.donate_round_state else ()
+    _train_round_jit = jax.jit(round_step, donate_argnums=round_donate)
 
     # ---------------- scanned multi-round driver -------------------------
-    @jax.jit
     def train_rounds(server: ServerState, clients: ClientState,
                      batches: RoundBatch, lrs, key):
         """Run N rounds as ONE device program (`lax.scan` over rounds):
@@ -570,15 +633,34 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             body, (server, clients), (batches, lrs))
         return server, clients, metrics, bits
 
+    train_rounds = jax.jit(train_rounds, donate_argnums=span_donate)
+
     class TrainRound:
         """Callable single-round step; `.train_rounds` runs a whole
-        scanned span of rounds in one device program."""
+        scanned span of rounds in one device program.
+
+        Caller contract under donation (Config.donate_round_state, the
+        default): `__call__` donates the ClientState operand and
+        `.train_rounds` donates BOTH state operands — after a dispatch
+        the caller must use the returned state, never the arrays it
+        passed in (FedModel reassigns immediately; a timing loop that
+        re-dispatches from one retained state object needs
+        donate_round_state=False). The registry attributes below are
+        graftaudit's trace surface: `round_step` is the un-jitted
+        single-round program body (what both jits compile — jax.
+        make_jaxpr over it yields the audited ClosedJaxpr), and the
+        *_donate_argnums record what the built jits actually donate,
+        checked against ROUND_DEAD_ARGNUMS / SPAN_DEAD_ARGNUMS."""
 
         def __call__(self, server, clients, batch, lr, key):
             return _train_round_jit(server, clients, batch, lr, key)
 
     handle = TrainRound()
     handle.train_rounds = train_rounds
+    handle.round_step = round_step
+    handle.round_donate_argnums = round_donate
+    handle.span_donate_argnums = span_donate
+    handle.cfg = cfg
     return handle
 
 

@@ -32,11 +32,13 @@ from __future__ import annotations
 
 import numpy as np
 
+from commefficient_tpu.analysis.domains import DOMAINS
 from commefficient_tpu.telemetry.clients import ClientThroughputTracker
 
-# counter-based PRNG domain tag for scheduler draws (distinct from
-# utils/faults: dropout 0x0D120, straggler 0x51044)
-SCHED_DOMAIN = 0x5C4ED
+# counter-based PRNG domain tag for scheduler draws — registered in
+# analysis/domains next to the dropout/straggler tags so uniqueness is
+# asserted in one place (and linted: GL009)
+SCHED_DOMAIN = DOMAINS["sampler"]
 
 SAMPLERS = ("uniform", "throughput")
 

@@ -48,6 +48,10 @@ consumers must tolerate kinds they don't know):
   profile_start / profile_stop   jax.profiler capture of operator-
                           selected spans (--profile_spans)
   bench_digest / profile_digest  bench harness result records
+  audit_digest            graftaudit's static cost report
+                          (analysis/audit): sha256 `digest`,
+                          per-program `programs` {flops, hbm_bytes},
+                          the traced `geometry`, and the finding count
 """
 from __future__ import annotations
 
@@ -210,7 +214,12 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
         cumulative views must agree);
       * `schedule` events carry an integer `round` and a `sampler`
         name; their optional deadline_s/est_round_s payloads are
-        non-negative numbers.
+        non-negative numbers;
+      * `audit_digest` events (graftaudit cost reports) carry a
+        non-empty string `digest` and a `programs` object mapping each
+        audited program to non-negative numeric flops/hbm_bytes — the
+        record a cost-regression investigation greps for, so its shape
+        must not rot.
 
     A `run_start` event opens a new run SEGMENT and resets the round
     tracking: a preempted run resumed with the same --journal_path
@@ -263,6 +272,32 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
             for field in ("deadline_s", "est_round_s",
                           "expected_round_s"):
                 _comm_field(rec, n, field)
+        if rec.get("event") == "audit_digest":
+            d = rec.get("digest")
+            if not (isinstance(d, str) and d):
+                problems.append(
+                    f"record {n}: audit_digest without a non-empty "
+                    f"string `digest` (got {d!r})")
+            progs = rec.get("programs")
+            if not isinstance(progs, dict):
+                problems.append(
+                    f"record {n}: audit_digest `programs` is not an "
+                    "object")
+            else:
+                for prog, cost in sorted(progs.items()):
+                    if not isinstance(cost, dict):
+                        problems.append(
+                            f"record {n}: audit_digest program "
+                            f"{prog!r} cost is not an object")
+                        continue
+                    for field in ("flops", "hbm_bytes"):
+                        v2 = cost.get(field)
+                        if not (isinstance(v2, (int, float))
+                                and v2 >= 0):
+                            problems.append(
+                                f"record {n}: audit_digest program "
+                                f"{prog!r} `{field}` must be a "
+                                f"non-negative number (got {v2!r})")
         if rec.get("event") == "run_end":
             total_down = _comm_field(rec, n, "down_bytes_total")
             total_up = _comm_field(rec, n, "up_bytes_total")

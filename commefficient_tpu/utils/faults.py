@@ -40,6 +40,8 @@ from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
+from commefficient_tpu.analysis.domains import DOMAINS
+
 
 class InjectedFault(RuntimeError):
     """Raised by FedModel when a FaultSchedule says the run crashes
@@ -65,7 +67,8 @@ def bernoulli_survivors(seed: int, round_idx: int, num_workers: int,
     if dropout <= 0.0:
         return np.ones(num_workers, np.float32)
     rng = np.random.default_rng(
-        np.random.SeedSequence([int(seed), 0x0D120, int(round_idx)]))
+        np.random.SeedSequence([int(seed), DOMAINS["dropout"],
+                                int(round_idx)]))
     return (rng.random(num_workers) >= dropout).astype(np.float32)
 
 
@@ -84,7 +87,8 @@ def straggler_work_fractions(seed: int, round_idx: int, num_workers: int,
     if rate <= 0.0:
         return np.ones(num_workers, np.float32)
     rng = np.random.default_rng(
-        np.random.SeedSequence([int(seed), 0x51044, int(round_idx)]))
+        np.random.SeedSequence([int(seed), DOMAINS["straggler"],
+                                int(round_idx)]))
     is_straggler = rng.random(num_workers) < rate
     frac = min_work + (1.0 - min_work) * rng.random(num_workers)
     return np.where(is_straggler, frac, 1.0).astype(np.float32)

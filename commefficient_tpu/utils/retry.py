@@ -17,6 +17,15 @@ scanned-span dispatch in `FedModel.run_rounds` (safe to retry because
 the scanned round program is functional — server/client state is only
 assigned from its RESULT, so a failed dispatch leaves nothing half
 mutated).
+
+Buffer-donation caveat (Config.donate_round_state, ISSUE 7): a
+donated span dispatch that fails mid-EXECUTION leaves its state
+operands deleted, so the retry's second attempt raises a fatal
+array-deleted RuntimeError (correctly classified non-transient here)
+instead of replaying. Staging-phase failures — where coordination
+blips actually occur — still retry. Runs that prioritize the retry
+guarantee over the in-place state HBM reuse pass
+--no_donate_round_state.
 """
 from __future__ import annotations
 

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# graftaudit: the repo's jaxpr-level program audit (rules AU001-AU006,
+# see README "Program auditing"). Runs from any cwd; extra args pass
+# through (e.g. `bash scripts/audit.sh --report`, `--list-rules`,
+# `--write-baseline`).
+#
+# Unlike graftlint this pass IMPORTS jax (it traces the round
+# programs), so it pins JAX_PLATFORMS=cpu — tracing needs no
+# accelerator and must never claim the TPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m commefficient_tpu.analysis.audit "$@"

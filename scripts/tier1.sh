@@ -12,6 +12,16 @@ set -o pipefail
 # fails tier-1 before any test runs — its log stays out of the pytest
 # capture below so DOTS_PASSED counting is unaffected
 bash "$(dirname "$0")/lint.sh" || { echo "GRAFTLINT_FAILED"; exit 1; }
+# program audit second (ISSUE 7): trace the round programs and check
+# forbidden primitives / population scaling / donation / the static
+# cost baseline. Its audit_digest is journaled and the journal must
+# validate, so the digest record format is exercised every CI run.
+AJR=/tmp/_t1_audit.jsonl
+rm -f "$AJR"
+timeout -k 10 300 bash "$(dirname "$0")/audit.sh" --journal "$AJR" \
+    || { echo "GRAFTAUDIT_FAILED"; exit 1; }
+python scripts/journal_summary.py "$AJR" \
+    || { echo "AUDIT_JOURNAL_INVALID"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

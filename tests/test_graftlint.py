@@ -199,6 +199,38 @@ GL008_NEG = """
         return lax.top_k(est, 50000)
 """
 
+GL009_POS = """
+    import jax
+    import numpy as np
+
+    def survivors(seed, round_idx, n):
+        rng = np.random.default_rng(
+            np.random.SeedSequence([seed, 0xBEEF1, round_idx]))
+        return rng.random(n)
+
+    @jax.jit
+    def round_key(key):
+        return jax.random.fold_in(key, 0xD00D)
+"""
+GL009_NEG = """
+    import jax
+    import numpy as np
+    from commefficient_tpu.analysis.domains import DOMAINS
+
+    def survivors(seed, round_idx, n):
+        # registry-routed tags are the sanctioned form
+        rng = np.random.default_rng(
+            np.random.SeedSequence([seed, DOMAINS["dropout"],
+                                    round_idx]))
+        return rng.random(n)
+
+    @jax.jit
+    def round_key(key, i):
+        # decimal per-round counters (round indices, worker slots) are
+        # stream POSITIONS, not domain tags — out of scope
+        return jax.random.fold_in(key, 7), jax.random.fold_in(key, i)
+"""
+
 FIXTURES = {
     "GL001": (GL001_POS, GL001_NEG),
     "GL002": (GL002_POS, GL002_NEG),
@@ -208,7 +240,37 @@ FIXTURES = {
     "GL006": (GL006_POS, GL006_NEG),
     "GL007": (GL007_POS, GL007_NEG),
     "GL008": (GL008_POS, GL008_NEG),
+    "GL009": (GL009_POS, GL009_NEG),
 }
+
+
+def test_gl009_registry_collision_is_flagged():
+    """A duplicate tag VALUE inside the registry dict itself is a
+    GL009 hit — but only when linting the registry file's path (the
+    pure-AST twin of the import-time uniqueness assert)."""
+    src = """
+        DOMAINS = {
+            "dropout": 0x0D120,
+            "straggler": 0x51044,
+            "sampler": 0x0D120,
+        }
+    """
+    vs = lint_source("commefficient_tpu/analysis/domains.py",
+                     textwrap.dedent(src))
+    assert [v.rule for v in vs] == ["GL009"]
+    assert "collision" in vs[0].message
+    # same source under any other path: a plain dict of hex ints is
+    # nobody's registry
+    assert codes(src) == []
+
+
+def test_gl009_shipped_registry_is_unique():
+    from commefficient_tpu.analysis.domains import DOMAINS
+    assert len(set(DOMAINS.values())) == len(DOMAINS)
+    # the three historical streams kept their frozen tags
+    assert DOMAINS["dropout"] == 0x0D120
+    assert DOMAINS["straggler"] == 0x51044
+    assert DOMAINS["sampler"] == 0x5C4ED
 
 
 @pytest.mark.parametrize("rule", sorted(ALL_RULES))

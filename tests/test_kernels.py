@@ -340,7 +340,10 @@ def test_pallas_round_exactly_three_programs(mesh, sanitize):
     SAME three round programs — mask-free, dropout, dropout+straggler
     — and nothing else (backend choice is static config, not an extra
     treedef), with every repeat dispatch a cache hit."""
-    cfg = _sketch_cfg(kernel_backend="pallas", sketch_table_dtype="bf16")
+    # the sweep re-dispatches all three programs from ONE retained
+    # state; donation would delete it (donated path: tests/test_audit)
+    cfg = _sketch_cfg(kernel_backend="pallas", sketch_table_dtype="bf16",
+                      donate_round_state=False)
     train_round, server, clients = _round_setup(mesh, cfg, place=True)
     b0, b1, b2, lr, key = _placed_batches(mesh)
     with sanitize.assert_program_count(3):
@@ -354,7 +357,8 @@ def test_pallas_round_zero_implicit_transfers(mesh, sanitize):
     """Interpret-mode pallas_call lowers INTO the jitted round (no
     callback escape hatch), so the fused-kernel round stays
     transfer-guard-clean like every other dispatch path."""
-    cfg = _sketch_cfg(kernel_backend="pallas", sketch_table_dtype="int8")
+    cfg = _sketch_cfg(kernel_backend="pallas", sketch_table_dtype="int8",
+                      donate_round_state=False)
     train_round, server, clients = _round_setup(mesh, cfg, place=True)
     b0, b1, b2, lr, key = _placed_batches(mesh)
     for b in (b0, b1, b2):  # compile outside the guard
@@ -378,7 +382,10 @@ def test_pallas_quantized_resume_bit_exact(mesh):
     kernels make the replay exact."""
     from commefficient_tpu.federated.round import ServerState
 
-    cfg = _sketch_cfg(kernel_backend="pallas", sketch_table_dtype="int8")
+    # the straight and resumed runs both start from ONE initial state
+    # object; donation would delete it after the first run's dispatch
+    cfg = _sketch_cfg(kernel_backend="pallas", sketch_table_dtype="int8",
+                      donate_round_state=False)
     x, y = _problem()
     batch = RoundBatch(jnp.arange(8, dtype=jnp.int32), (x, y),
                        jnp.ones((8, 4)))

@@ -38,7 +38,12 @@ def setup(mesh, mode="uncompressed", num_workers=8, **kw):
     vec, unravel = flatten_params(params)
     base = dict(mode=mode, grad_size=D, weight_decay=0.0, num_workers=num_workers,
                 local_momentum=0.0, virtual_momentum=0.0, error_type="none",
-                microbatch_size=-1, num_clients=num_workers)
+                microbatch_size=-1, num_clients=num_workers,
+                # these tests re-dispatch from retained state objects
+                # (A/B comparisons from one initial state); donation
+                # would delete the operands after the first call. The
+                # donated twins live in tests/test_audit.py.
+                donate_round_state=False)
     base.update(kw)
     cfg = Config(**base)
     train_round, eval_batch = make_round_fns(loss_fn, unravel, cfg, mesh)
@@ -283,7 +288,12 @@ def _sanitized_round_setup(mesh):
     cfg = _Config(mode="uncompressed", grad_size=D, weight_decay=0.0,
                   num_workers=8, local_momentum=0.0,
                   virtual_momentum=0.0, error_type="none",
-                  microbatch_size=-1, num_clients=8)
+                  microbatch_size=-1, num_clients=8,
+                  # the sanitizer sweeps dispatch all three programs
+                  # from ONE retained state; the donated twin of both
+                  # proofs is tests/test_audit.py's
+                  # test_donated_dispatch_three_programs_and_no_transfers
+                  donate_round_state=False)
     from commefficient_tpu.federated.round import make_round_fns
     train_round, _ = make_round_fns(loss_fn, unravel, cfg, mesh)
     from commefficient_tpu.federated.round import (

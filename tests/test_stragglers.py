@@ -164,7 +164,11 @@ def test_work_ones_matches_workfree_program(mesh):
     key = jax.random.PRNGKey(0)
     for mode, extra in (("uncompressed", {}),        # fused backward
                         ("local_topk", dict(k=2, error_type="local"))):
-        _, tr, server, clients = _engine(mesh, mode, **extra)
+        # A/B dispatch from ONE initial state: donation would delete
+        # it after the first call (donated path: tests/test_audit.py)
+        _, tr, server, clients = _engine(mesh, mode,
+                                         donate_round_state=False,
+                                         **extra)
         ids = jnp.arange(8, dtype=jnp.int32)
         plain = RoundBatch(ids, (x, y), jnp.ones((8, 4)))
         worked = plain._replace(survivors=jnp.ones(8), work=jnp.ones(8))
