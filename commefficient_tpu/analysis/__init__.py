@@ -9,12 +9,13 @@ mechanical:
 
   * `engine` + `rules` — an AST lint pass (``python -m
     commefficient_tpu.analysis <paths>``) with JAX-specific rules
-    GL001-GL009: host nondeterminism reachable from traced code, hidden
+    GL001-GL010: host nondeterminism reachable from traced code, hidden
     host syncs / trace breaks, PRNG key reuse, Python control flow over
     traced values, fault-swallowing broad ``except`` handlers,
     non-atomic file writes, unconstrained shard_map/pjit layouts,
-    large exact top-k, and PRNG domain tags outside the `domains`
-    registry. Per-line ``# graftlint: disable=GLxxx`` suppressions and
+    large exact top-k, PRNG domain tags outside the `domains`
+    registry, and mesh-axis names outside its MESH_AXES registry.
+    Per-line ``# graftlint: disable=GLxxx`` suppressions and
     a baseline file grandfather justified hits.
   * `audit` + `costmodel` — the SECOND tier (``graftaudit``, ISSUE 7):
     traces the three round programs per config/backend to ClosedJaxprs
@@ -23,9 +24,21 @@ mechanical:
     (with the named client-state inventory), buffer-donation coverage,
     and a static FLOPs/HBM cost report gated against the committed
     ``audit.baseline.json``.
-  * `domains` — the central PRNG-domain registry (dropout / straggler
-    / sampler stream tags) whose uniqueness GL009 and an import-time
-    assert both enforce.
+  * `shardaudit` — the THIRD tier (``graftmesh`` / ``graftaudit
+    --mesh``, ISSUE 8): traces the round programs + the scanned span
+    under explicit multi-device meshes (the real parallel/mesh.py
+    constructors on the simulated 8-device host platform) and checks
+    the sharding/collective contracts — replication across the
+    clients axis, population-scaling collectives, missing shardings,
+    link-class placement (one table-sized DCN reduction per round),
+    resharding vs the single-device program — plus a deterministic
+    per-link ICI/DCN byte report gated against
+    ``meshaudit.baseline.json`` (rules AU007-AU011; exit 1 =
+    violations, 2 = baseline drift, shared with graftaudit).
+  * `domains` — the central registries: PRNG-domain tags (dropout /
+    straggler / sampler) whose uniqueness GL009 and an import-time
+    assert both enforce, and the MESH_AXES axis-name registry GL010
+    holds the sharding layer to.
   * `runtime` — sanitizers armed by tests: ``assert_program_count(n)``
     (a compilation counter enforcing the three-programs contract) and
     ``forbid_transfers()`` (``jax.transfer_guard`` proving the jitted

@@ -331,16 +331,19 @@ def donation_findings(config_name: str, handle) -> List[AuditFinding]:
 # traced jaxprs are the production programs at audit geometry
 
 
-def audit_configs(backends: Sequence[str] = ("xla", "pallas")):
+def audit_configs(backends: Sequence[str] = ("xla", "pallas"),
+                  population: int = AUDIT_POPULATION):
     """(name, Config) pairs the auditor traces. Two sketch configs pin
     the compression hot path on each kernel backend; `client-state`
     (local_topk + local error + momentum + topk_down) is the config
-    whose per-client rows populate the AU004 inventory."""
+    whose per-client rows populate the AU004 inventory. `population`
+    overrides the num_clients sentinel (the mesh tier,
+    analysis/shardaudit, needs one divisible by its clients axes)."""
     from commefficient_tpu.config import Config
     g = AUDIT_GEOMETRY
     base = dict(weight_decay=0.0, num_workers=g["W"],
                 microbatch_size=-1, grad_size=g["D"],
-                num_clients=AUDIT_POPULATION, seed=0)
+                num_clients=population, seed=0)
     out = []
     for b in backends:
         out.append((f"sketch-{b}", Config(
@@ -430,7 +433,15 @@ class AuditBaseline:
     """audit.baseline.json: {"violations": [{program, rule, count,
     justification}], "costs": {program: {flops, hbm_bytes}}}. Same
     exact-match semantics as graftlint's Baseline: new hits AND stale
-    entries both error, so the file can only change deliberately."""
+    entries both error, so the file can only change deliberately.
+
+    COST_KEY / COST_FIELDS parameterize the per-program cost block so
+    the mesh tier (analysis/shardaudit.MeshBaseline) reuses the whole
+    diff machinery over its per-link byte report."""
+
+    COST_KEY = "costs"
+    COST_FIELDS = ("flops", "hbm_bytes")
+    DRIFT_RULE = "AU006"
 
     def __init__(self, violations=None, costs=None):
         self.violations: Dict[Tuple[str, str], Tuple[int, str]] = dict(
@@ -445,7 +456,7 @@ class AuditBaseline:
         for e in raw.get("violations", ()):
             violations[(e["program"], e["rule"])] = (
                 int(e["count"]), e.get("justification", ""))
-        return cls(violations, raw.get("costs", {}))
+        return cls(violations, raw.get(cls.COST_KEY, {}))
 
     def dump(self, path: str) -> None:
         doc = {
@@ -455,7 +466,7 @@ class AuditBaseline:
                  "justification": j}
                 for (p, r), (n, j) in sorted(self.violations.items())
             ],
-            "costs": {k: self.costs[k] for k in sorted(self.costs)},
+            self.COST_KEY: {k: self.costs[k] for k in sorted(self.costs)},
         }
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
@@ -488,21 +499,22 @@ class AuditBaseline:
             got = costs[prog]
             base = self.costs.get(prog)
             if base is None:
+                summary = ", ".join(f"{f}={got[f]}"
+                                    for f in self.COST_FIELDS)
                 out.append(AuditFinding(
-                    prog, "AU006",
-                    f"no cost baseline for this program (flops="
-                    f"{got['flops']}, hbm_bytes={got['hbm_bytes']}); "
+                    prog, self.DRIFT_RULE,
+                    f"no cost baseline for this program ({summary}); "
                     "a new program must be priced deliberately — run "
                     "--write-baseline and commit the diff"))
                 continue
-            for field in ("flops", "hbm_bytes"):
+            for field in self.COST_FIELDS:
                 want, have = int(base.get(field, 0)), int(got[field])
                 lo = want * (1.0 - tolerance)
                 hi = want * (1.0 + tolerance)
                 if not (lo <= have <= hi):
                     direction = "regressed" if have > want else "moved"
                     out.append(AuditFinding(
-                        prog, "AU006",
+                        prog, self.DRIFT_RULE,
                         f"static {field} {direction}: baseline {want}, "
                         f"traced {have} "
                         f"({(have - want) / max(want, 1):+.1%}, "
@@ -511,10 +523,38 @@ class AuditBaseline:
         for prog in sorted(self.costs):
             if prog not in costs:
                 out.append(AuditFinding(
-                    prog, "AU006",
+                    prog, self.DRIFT_RULE,
                     "stale cost baseline: program no longer traced by "
                     "the audit — regenerate with --write-baseline"))
         return out
+
+
+# ---------------------------------------------------------------------------
+# the shared graftaudit/graftmesh exit-code contract (ISSUE 8
+# satellite): 0 clean, 1 rule violations, 2 baseline drift only —
+# lives HERE (tier 2) because both CLIs depend on it and the mesh
+# tier already imports this module, never the reverse. Drift is every
+# *AU006-suffixed finding (AU006 cost drift, graftmesh's MAU006 link
+# drift) plus stale baseline entries.
+
+
+def split_findings(findings: Sequence[AuditFinding]
+                   ) -> Tuple[List[AuditFinding], List[AuditFinding]]:
+    """(rule violations, baseline drift)."""
+    violations = [f for f in findings if not f.rule.endswith("AU006")]
+    drift = [f for f in findings if f.rule.endswith("AU006")]
+    return violations, drift
+
+
+def exit_code(violations: Sequence, drift: Sequence,
+              stale: Sequence) -> int:
+    """0 clean, 1 rule violations (whatever else rode along), 2
+    baseline drift only."""
+    if violations:
+        return 1
+    if drift or stale:
+        return 2
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -591,6 +631,16 @@ def main(argv: Optional[list] = None) -> int:
     # never claim an accelerator: the audit only traces
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # third-tier delegation: `graftaudit --mesh [...]` IS the mesh
+    # audit (analysis/shardaudit, also shipped as `graftmesh`) — the
+    # remaining args are interpreted by graftmesh's own parser, so
+    # `graftaudit --mesh --write-baseline` regenerates
+    # meshaudit.baseline.json, not audit.baseline.json
+    if "--mesh" in argv or "--list-meshes" in argv:
+        from commefficient_tpu.analysis import shardaudit
+        return shardaudit.main([a for a in argv if a != "--mesh"])
+
     from commefficient_tpu.analysis.engine import load_pyproject_tool
     conf = load_pyproject_tool("graftaudit")
     ap = argparse.ArgumentParser(
@@ -598,7 +648,9 @@ def main(argv: Optional[list] = None) -> int:
         description="jaxpr-level program auditor: forbidden "
                     "primitives, population scaling, buffer donation, "
                     "static cost baselines (rules AU001-AU006; see "
-                    "--list-rules)")
+                    "--list-rules). --mesh runs the mesh-aware third "
+                    "tier (graftmesh, rules AU007-AU011) instead; "
+                    "--list-meshes shows its mesh registry.")
     ap.add_argument("--baseline",
                     default=conf.get("baseline", "audit.baseline.json"),
                     help="baseline file (grandfathered violations + "
@@ -625,15 +677,18 @@ def main(argv: Optional[list] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for code, doc in sorted(AUDIT_RULE_DOCS.items()):
+        from commefficient_tpu.analysis.shardaudit import MESH_RULE_DOCS
+        for code, doc in sorted({**AUDIT_RULE_DOCS,
+                                 **MESH_RULE_DOCS}.items()):
             print(f"{code}  {doc}")
         return 0
 
     for b in args.backends:
         if b not in ("xla", "pallas"):
+            # 3, not 2: exit 2 is reserved for baseline drift
             print(f"graftaudit: unknown backend {b!r}",
                   file=sys.stderr)
-            return 2
+            return 3
 
     report, findings = run_audit(args.backends)
 
@@ -669,11 +724,17 @@ def main(argv: Optional[list] = None) -> int:
         print(f.render())
     for msg in stale:
         print(f"graftaudit: {msg}")
-    if findings or stale:
-        print(f"graftaudit: {len(findings)} finding(s)"
-              + (f", {len(stale)} baseline problem(s)" if stale
-                 else ""))
-        return 1
+    # exit-code contract shared with graftmesh (ISSUE 8 satellite):
+    # 1 = rule violations (AU001-AU005), 2 = baseline drift only
+    # (AU006 cost mismatch / stale entries) — CI can tell "the program
+    # broke a contract" from "re-commit the baseline"
+    violations, drift = split_findings(findings)
+    rc = exit_code(violations, drift, stale)
+    if rc:
+        print(f"graftaudit: {len(violations)} violation(s), "
+              f"{len(drift)} drift finding(s), {len(stale)} stale "
+              f"baseline entr(ies)")
+        return rc
     print(f"graftaudit: clean ({len(report['programs'])} program(s) "
           f"audited, digest {report['digest'][:12]})")
     return 0

@@ -41,6 +41,7 @@ module moves.
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -290,4 +291,176 @@ def jaxpr_cost(jaxpr) -> Cost:
         else:
             # elementwise default: one op per output element
             cost.add(name, _out_numel(eqn), _eqn_bytes(eqn))
+    return cost
+
+
+# ---------------------------------------------------------------------------
+# per-link collective cost (graftmesh, ISSUE 8)
+#
+# The FLOPs/HBM model above prices a program as if it ran on one
+# device; the collective model below prices its COMMUNICATION under an
+# explicit mesh, split by link class — intra-slice ICI vs inter-slice
+# DCN — because the round engine's scaling contract is stated in
+# exactly those terms (parallel/mesh.make_multihost_client_mesh: one
+# table-sized all-reduce crosses DCN per round, model-axis collectives
+# never do). Like the FLOPs model it is a MODEL, not a prediction:
+# every collective is priced as a hierarchical ring (one ring stage
+# per slice over ICI, one ring over the slices for the DCN stage),
+# all-reduce at factor 2 (reduce-scatter + all-gather), everything
+# else at factor 1. The absolute bytes are approximate; what the
+# meshaudit baseline gates on is their STABILITY and their SPLIT —
+# a new collective, a payload that grew, or traffic moving from ICI
+# to DCN all change the report exactly.
+
+# collective primitive names -> byte factor over the payload; the
+# payload is operand bytes (reduce-type) or output bytes (all_gather,
+# whose logical payload is the gathered result)
+_COLLECTIVE_FACTORS = {
+    "psum": 2, "psum2": 2, "psum_invariant": 2, "pmax": 2, "pmin": 2,
+    "all_gather": 1, "reduce_scatter": 1, "all_to_all": 1,
+    "ppermute": 1, "pbroadcast": 1,
+}
+_OUTPUT_PAYLOAD = frozenset({"all_gather"})
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLinkModel:
+    """Link-class description of one mesh, consumed by
+    `collective_cost`. Deliberately jax-free: the shardaudit tier
+    builds one from a real jax Mesh + slice map; tests can construct
+    them directly.
+
+    axis_sizes:  {axis name: device count along it}
+    axis_slices: {axis name: number of DISTINCT slices one group along
+                  that axis spans}. 1 means the axis is pure ICI; S > 1
+                  means a collective over it must run a DCN stage over
+                  S slice groups (with size/S devices per slice on ICI).
+    """
+    name: str
+    axis_sizes: Tuple[Tuple[str, int], ...]
+    axis_slices: Tuple[Tuple[str, int], ...]
+
+    def size(self, axis: str) -> int:
+        return dict(self.axis_sizes).get(axis, 1)
+
+    def slices(self, axis: str) -> int:
+        return dict(self.axis_slices).get(axis, 1)
+
+    def as_dict(self) -> dict:
+        return {"axes": {a: n for a, n in self.axis_sizes},
+                "slices": {a: s for a, s in self.axis_slices}}
+
+
+@dataclasses.dataclass
+class CollectiveRecord:
+    """One collective equation, priced. `mult` is the container
+    multiplier (a collective inside a scanned span of N rounds runs N
+    times; bytes below already include it)."""
+    kind: str
+    axes: Tuple[str, ...]
+    payload_bytes: int               # one execution's logical payload
+    operand_shapes: Tuple[Tuple[int, ...], ...]
+    mult: int
+    ici_bytes: int                   # mult-inclusive
+    dcn_bytes: int                   # mult-inclusive
+    crosses_dcn: bool
+
+
+class CollectiveCost:
+    """Per-link rollup of every collective in one program."""
+
+    def __init__(self):
+        self.records: List[CollectiveRecord] = []
+        self.ici_bytes = 0
+        self.dcn_bytes = 0
+        self.dcn_collectives = 0     # mult-inclusive executions
+
+    def add(self, rec: CollectiveRecord) -> None:
+        self.records.append(rec)
+        self.ici_bytes += rec.ici_bytes
+        self.dcn_bytes += rec.dcn_bytes
+        if rec.crosses_dcn:
+            self.dcn_collectives += rec.mult
+
+    def as_dict(self) -> dict:
+        """Canonical JSON-able per-link report (bit-stable ordering)."""
+        by_kind: Dict[str, Dict[str, int]] = {}
+        for r in self.records:
+            row = by_kind.setdefault(r.kind, {"count": 0, "bytes": 0})
+            row["count"] += r.mult
+            row["bytes"] += r.ici_bytes + r.dcn_bytes
+        return {
+            "ici_bytes": self.ici_bytes,
+            "dcn_bytes": self.dcn_bytes,
+            "dcn_collectives": self.dcn_collectives,
+            "collectives": {k: dict(by_kind[k]) for k in sorted(by_kind)},
+        }
+
+
+def eqn_collective_axes(eqn) -> Tuple[str, ...]:
+    """Named mesh axes one collective eqn spans (positional axis
+    indices — vmapped collectives — carry no mesh link and are
+    skipped)."""
+    axes = eqn.params.get("axes")
+    if axes is None:
+        axes = eqn.params.get("axis_name", ())
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _price_collective(eqn, link: MeshLinkModel, mult: int
+                      ) -> Optional[CollectiveRecord]:
+    kind = eqn.primitive.name
+    factor = _COLLECTIVE_FACTORS[kind]
+    axes = eqn_collective_axes(eqn)
+    if not axes:
+        return None
+    if kind in _OUTPUT_PAYLOAD:
+        payload = sum(aval_bytes(v.aval) for v in eqn.outvars)
+    else:
+        payload = sum(aval_bytes(a) for a in _operand_avals(eqn))
+    ici = dcn = 0
+    crosses = False
+    # hierarchical ring, axis by axis: S slice groups of n/S devices —
+    # each slice group rings the payload over ICI, then one ring over
+    # the S groups crosses DCN with the full payload
+    for a in axes:
+        n = link.size(a)
+        s = max(link.slices(a), 1)
+        n_inner = max(n // s, 1)
+        ici += factor * (n_inner - 1) * payload * s
+        if s > 1:
+            dcn += factor * (s - 1) * payload
+            crosses = True
+    return CollectiveRecord(
+        kind=kind, axes=axes, payload_bytes=payload,
+        operand_shapes=tuple(tuple(int(d) for d in a.shape)
+                             for a in _operand_avals(eqn)),
+        mult=mult, ici_bytes=ici * mult, dcn_bytes=dcn * mult,
+        crosses_dcn=crosses)
+
+
+def collective_cost(jaxpr, link: MeshLinkModel) -> CollectiveCost:
+    """Walk one jaxpr (Closed or raw) and price every collective over
+    `link`'s axes, carrying container multipliers (scan trip counts)
+    exactly like `jaxpr_cost`."""
+    inner = getattr(jaxpr, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        jaxpr = inner
+    cost = CollectiveCost()
+
+    def walk(jx, mult):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in _COLLECTIVE_FACTORS:
+                rec = _price_collective(eqn, link, mult)
+                if rec is not None:
+                    cost.add(rec)
+            sub_mult = mult * _container_multiplier(eqn)
+            for v in eqn.params.values():
+                for s in sub_jaxprs(v):
+                    walk(s, sub_mult)
+
+    walk(jaxpr, 1)
     return cost

@@ -1,4 +1,4 @@
-"""Central PRNG-domain registry: every counter-based draw's domain tag.
+"""Central registries: PRNG-domain tags and mesh axis names.
 
 The engine's determinism story rests on DOMAIN SEPARATION: the dropout,
 straggler, and scheduler draws are each a pure function of
@@ -54,3 +54,28 @@ def domain(name: str) -> int:
             f"unknown PRNG domain {name!r}; registered: "
             f"{sorted(DOMAINS)} (add new streams to analysis/domains)"
         ) from None
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis registry (ISSUE 8 satellite; enforced by graftlint GL010)
+#
+# The engine's sharding story names exactly two mesh axes: `clients`
+# (the federated parallel axis every round program shards over) and
+# `model` (optional tensor parallelism, innermost so its collectives
+# ride the fastest ICI). Before this registry the names lived as
+# string literals spread across parallel/ and federated/; a typo
+# ("cleints") or an unregistered new axis produced a silently
+# replicated spec — the layout bug class GSPMD propagation hides
+# until a pod run reshards every dispatch. GL010 holds the line: an
+# axis-name string literal in a sharding construction under parallel/
+# or federated/ that is not a MESH_AXES value is a lint error, and the
+# mesh constructors themselves build their axis_names from these
+# constants. (ring_attention's `seq` axis is caller-named — it takes
+# the axis as a parameter and registers no literal of its own.)
+
+CLIENTS_AXIS = "clients"
+MODEL_AXIS = "model"
+MESH_AXES = (CLIENTS_AXIS, MODEL_AXIS)
+
+assert len(set(MESH_AXES)) == len(MESH_AXES), (
+    "duplicate axis name in analysis/domains.MESH_AXES")

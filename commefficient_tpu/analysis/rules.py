@@ -1,4 +1,4 @@
-"""graftlint rules GL001-GL009.
+"""graftlint rules GL001-GL010.
 
 Each rule is a function ``check(module: ModuleInfo) -> Iterator[
 Violation]`` over one parsed file. The rules are deliberately
@@ -676,6 +676,93 @@ def check_gl009(module: ModuleInfo) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GL010 — mesh-axis names outside the central registry
+
+# The sharding layer's axis names live in analysis/domains.MESH_AXES
+# (`clients`, `model`) — the one place a reviewer audits the mesh
+# layout, mirroring the GL009 PRNG-domain discipline. This rule holds
+# the line syntactically in the two packages that construct shardings
+# (parallel/, federated/): a string literal at an axis-name position —
+# a PartitionSpec/P argument, a Mesh axis_names entry, a shard_map
+# axis_names member, a psum-family axis argument — that is not a
+# registered MESH_AXES value is a typo or an unregistered axis, either
+# of which GSPMD would silently absorb as a fully-replicated spec
+# (the graftmesh AU007 failure class, caught here before a trace is
+# ever needed). Literals that ARE registry values are fine: the rule
+# checks by value, so P("clients") and P(CLIENTS_AXIS) are equally
+# clean — migration to the constants is hygiene, not a lint gate.
+
+from commefficient_tpu.analysis.domains import MESH_AXES  # noqa: E402
+
+_GL010_SCOPES = ("/parallel/", "/federated/")
+# call terminal -> how to find axis-name strings: "args" scans every
+# positional/keyword argument expression for string constants;
+# "mesh_ctor" scans the axis_names kwarg plus its positional slot
+# (Mesh(devs, ("clients",))); "kwarg_only" scans only the kwarg
+# (shard_map's positional slot 1 is the MESH argument, whose
+# expression may legitimately contain unrelated strings)
+_GL010_SINKS = {
+    "PartitionSpec": "args",
+    "P": "args",
+    "Mesh": "mesh_ctor",
+    "shard_map": "kwarg_only",
+    "psum": "axis_arg",
+    "pmax": "axis_arg",
+    "pmin": "axis_arg",
+    "all_gather": "axis_arg",
+    "pbroadcast": "axis_arg",
+    "pcast": "axis_arg",
+}
+
+
+def _string_constants(expr: ast.AST) -> Iterator[ast.Constant]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node
+
+
+def check_gl010(module: ModuleInfo) -> Iterator[Violation]:
+    path = "/" + module.path.replace(os.sep, "/")
+    if not any(scope in path for scope in _GL010_SCOPES):
+        return
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        sink = _GL010_SINKS.get(_terminal(_dotted(node.func)))
+        if sink is None:
+            continue
+        if sink == "args":
+            exprs = list(node.args) + [kw.value for kw in node.keywords]
+        elif sink == "axis_arg":
+            # psum(x, "clients") / all_gather(x, "clients", ...) /
+            # pcast(x, "clients", to=...): the axis rides the second
+            # positional slot or an axis_name(s) kwarg
+            exprs = node.args[1:2] + [kw.value for kw in node.keywords
+                                      if kw.arg in ("axis_name",
+                                                    "axis_names")]
+        else:
+            # Mesh(devs, axis_names) / Mesh(devs, axis_names=...) —
+            # positional slot only for the constructor, where slot 1
+            # IS the axis tuple
+            exprs = node.args[1:2] if sink == "mesh_ctor" else []
+            exprs += [kw.value for kw in node.keywords
+                      if kw.arg == "axis_names"]
+        for expr in exprs:
+            for const in _string_constants(expr):
+                if const.value in MESH_AXES:
+                    continue
+                yield Violation(
+                    module.path, const.lineno, const.col_offset,
+                    "GL010",
+                    f"axis name {const.value!r} in a sharding "
+                    "construction is not in the mesh-axis registry "
+                    f"(analysis/domains.MESH_AXES = {MESH_AXES}): a "
+                    "typo or unregistered axis becomes a silently "
+                    "replicated spec under GSPMD propagation — use a "
+                    "registered axis (or register the new one)")
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "GL001": check_gl001,
@@ -687,6 +774,7 @@ ALL_RULES = {
     "GL007": check_gl007,
     "GL008": check_gl008,
     "GL009": check_gl009,
+    "GL010": check_gl010,
 }
 
 RULE_DOCS = {
@@ -709,4 +797,7 @@ RULE_DOCS = {
     "GL009": "PRNG domain tag outside the analysis/domains registry "
              "(inline hex in fold_in/SeedSequence, or a registry "
              "collision)",
+    "GL010": "mesh-axis name in a sharding construction (parallel/, "
+             "federated/) outside the analysis/domains MESH_AXES "
+             "registry",
 }

@@ -64,6 +64,22 @@ class FedSampler:
         self.rng = np.random.RandomState(seed)
         self.shuffle_clients = shuffle_clients
         self.scheduler = scheduler
+        # checkpointable stream state (ISSUE 8 satellite — the named
+        # PR-5 opening): `_epoch` mirrors the LIVE epoch generator's
+        # cursor/permutations/position so state_dict() can capture a
+        # suspended mid-epoch stream; `_pending` holds a restored
+        # mid-epoch state the next epoch() call continues from instead
+        # of re-drawing. Without this, a non-uniform (tracker-driven)
+        # resume could only REPLAY the epoch head against the
+        # checkpoint-time tracker, re-drawing different selections and
+        # therefore feeding a different data stream than the
+        # uninterrupted run.
+        self._epoch: Optional[dict] = None
+        self._pending: Optional[dict] = None
+        # set by load_state_dict, consumed by resolve_resume: a
+        # restored rng (even without a mid-epoch stream) makes any
+        # head-replay skip wrong
+        self._restored = False
         if num_workers > self.num_clients:
             raise ValueError(
                 f"num_workers={num_workers} > num_clients={self.num_clients}")
@@ -97,13 +113,29 @@ class FedSampler:
     def epoch(self) -> Iterator[RoundIndices]:
         B = self.round_batch_size
         dpc = self.data_per_client
-        # per-client permutation of local indices
-        perms = [self.rng.permutation(n) for n in dpc]
-        cursor = np.zeros(self.num_clients, dtype=int)
+        if self._pending is not None:
+            # continue a checkpoint-restored mid-epoch stream: the
+            # restored rng state already reflects every draw up to the
+            # suspension point, so nothing is re-drawn
+            st, self._pending = self._pending, None
+            perms, cursor, pos = st["perms"], st["cursor"], st["pos"]
+        else:
+            # per-client permutation of local indices
+            perms = [self.rng.permutation(n) for n in dpc]
+            cursor = np.zeros(self.num_clients, dtype=int)
+            pos = 0
+        # instance mirror of the generator's locals: perms/cursor are
+        # mutated in place below, so state_dict() sees the suspended
+        # stream's exact position. Deliberately NOT cleared in a
+        # finally block — an abandoned generator is cleared at GC
+        # time, which would make state capture depend on collector
+        # timing; exhaustion clears it, epoch() overwrites it.
+        self._epoch = {"perms": perms, "cursor": cursor, "pos": pos}
 
         while True:
             alive = np.where(cursor < dpc)[0]
             if len(alive) < self.num_workers:
+                self._epoch = None
                 return
             if self.scheduler is not None:
                 # policy selection (possibly < num_workers under an
@@ -138,7 +170,130 @@ class FedSampler:
                 cursor[cid] += take
             if self.scheduler is not None:
                 self.scheduler.commit_round(slot_ids, mask.sum(axis=1))
+            self._epoch["pos"] += 1
             yield RoundIndices(slot_ids.astype(np.int32), idx, mask)
+
+    # ---------------- checkpointable stream state ------------------------
+
+    @property
+    def resume_pending(self) -> bool:
+        """True when a restored mid-epoch stream is waiting for the
+        next epoch() call."""
+        return self._pending is not None
+
+    @property
+    def pending_pos(self) -> Optional[int]:
+        """Epoch-relative position (rounds already drawn) of the
+        restored mid-epoch stream, or None without one. The drivers
+        compare this against their own per-epoch round cap: a
+        restored stream that already REACHED the cap was abandoned by
+        the uninterrupted run at that exact point (driver stream
+        wrappers cap, then abandon_epoch), so the resume must discard
+        it (discard_pending) and open a fresh epoch instead —
+        and a stream short of the cap must only be driven for the
+        REMAINING cap - pos rounds."""
+        return (None if self._pending is None
+                else int(self._pending["pos"]))
+
+    def discard_pending(self) -> None:
+        """Drop a restored mid-epoch stream (see pending_pos): the
+        next epoch() call draws fresh permutations from the restored
+        rng — which already includes every draw of the abandoned
+        stream, so the fresh epoch matches the uninterrupted run's."""
+        self._pending = None
+
+    def abandon_epoch(self) -> None:
+        """Driver hook: the epoch's stream is logically OVER even
+        though the generator never exhausted (the drivers' per-epoch
+        round caps end epochs by abandoning the stream, after a
+        pull-then-discard). Clears the live-stream mirror so a
+        checkpoint written after this point records in_epoch=0 — a
+        resume then opens a fresh epoch from the restored rng, exactly
+        what the uninterrupted run does. The rng itself is untouched:
+        it must keep the abandoned stream's draws (the uninterrupted
+        timeline made them too). Callers MUST invoke this before any
+        checkpoint that follows the abandonment (the drivers' stream
+        wrappers do, ahead of the scanned tail flush)."""
+        self._epoch = None
+
+    def resolve_resume(self, skip_rounds: int) -> int:
+        """Driver hook at resume time: returns the `epoch(skip=)`
+        value to use for the first resumed epoch.
+
+        Whenever THIS run restored sampler state (load_state_dict),
+        the answer is 0 — the restored rng/cursor already encode the
+        stream position exactly, so any skip would throw away rounds
+        the uninterrupted run trains (the old spe-modulus fast-forward
+        mis-skips whenever real epoch length drifts from the
+        steps_per_epoch estimate — exhaustion-ended epochs, capped
+        whole-client batches). Whether the next epoch() call continues
+        a mid-epoch stream or opens a fresh one is decided by the
+        CHECKPOINT (in_epoch — the drivers mark stream abandonment via
+        abandon_epoch before checkpointing, so a saved live stream is
+        genuinely live), never inferred from skip_rounds. Without
+        restored state this is the identity: legacy checkpoints keep
+        the replay fast-forward path."""
+        if not self._restored:
+            return int(skip_rounds)
+        self._restored = False
+        return 0
+
+    def state_dict(self) -> dict:
+        """Bit-exact serializable stream state: the MT19937 generator
+        plus — when an epoch stream is live — its per-client
+        permutations, cursors and position. All plain numpy arrays
+        (checkpoint .npz friendly, `smp_*` keys)."""
+        kind, key, pos, has_gauss, cached = self.rng.get_state()
+        assert kind == "MT19937"
+        out = {
+            "rng_key": np.asarray(key, np.uint32),
+            "rng_pos": np.int64(pos),
+            "rng_has_gauss": np.int64(has_gauss),
+            "rng_cached": np.float64(cached),
+            "in_epoch": np.int64(0),
+        }
+        st = self._epoch if self._epoch is not None else self._pending
+        if st is not None:
+            out["in_epoch"] = np.int64(1)
+            out["epoch_pos"] = np.int64(st["pos"])
+            out["cursor"] = np.asarray(st["cursor"], np.int64)
+            out["perm_flat"] = (
+                np.concatenate([np.asarray(p, np.int64)
+                                for p in st["perms"]])
+                if len(st["perms"]) else np.zeros((0,), np.int64))
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a state_dict() capture. Mid-epoch state parks in
+        `_pending`; the next epoch() call continues the stream from
+        the restored cursor instead of drawing fresh permutations."""
+        self.rng.set_state((
+            "MT19937", np.asarray(state["rng_key"], np.uint32),
+            int(np.asarray(state["rng_pos"])),
+            int(np.asarray(state["rng_has_gauss"])),
+            float(np.asarray(state["rng_cached"]))))
+        self._epoch = None
+        self._pending = None
+        self._restored = True
+        if not int(np.asarray(state.get("in_epoch", 0))):
+            return
+        cursor = np.asarray(state["cursor"], dtype=int)
+        flat = np.asarray(state["perm_flat"], dtype=int)
+        dpc = self.data_per_client
+        if cursor.shape[0] != self.num_clients or \
+                flat.shape[0] != int(dpc.sum()):
+            raise ValueError(
+                "sampler checkpoint does not match this dataset: "
+                f"cursor for {cursor.shape[0]} clients / "
+                f"{flat.shape[0]} permutation entries vs "
+                f"{self.num_clients} clients / {int(dpc.sum())} "
+                "examples")
+        perms, off = [], 0
+        for n in dpc:
+            perms.append(flat[off:off + int(n)].copy())
+            off += int(n)
+        self._pending = {"perms": perms, "cursor": cursor.copy(),
+                         "pos": int(np.asarray(state["epoch_pos"]))}
 
 
 class ValSampler:

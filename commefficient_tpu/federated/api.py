@@ -187,6 +187,11 @@ class FedModel:
         # leaves every code path bit-identical to a scheduler-free
         # build
         self.scheduler = None
+        # the run's FedSampler (data/sampler.py), attached by
+        # scheduler.attach_round_scheduler so its stream state rides
+        # in checkpoints (smp_* keys) — the exact-data-stream resume
+        # contract under non-uniform sampling
+        self.data_sampler = None
         # per-round scheduled-slot masks (RoundPlan.active), stashed
         # at plan consumption and handed to the telemetry feeding so
         # idle over-provisioned pads are EXCLUDED from the throughput
@@ -220,6 +225,22 @@ class FedModel:
         return (self.scheduler.state_dict()
                 if self.scheduler is not None else None)
 
+    def attach_data_sampler(self, sampler) -> None:
+        """Install the run's FedSampler (or None to detach). Its
+        stream state — rng, mid-epoch cursor and permutations — rides
+        in checkpoints under `smp_*` and load_state restores it, so a
+        resumed run CONTINUES the exact data stream rather than
+        replaying the epoch head (which, under non-uniform sampling,
+        would re-draw against the checkpoint-time tracker and feed
+        different data than the uninterrupted run)."""
+        self.data_sampler = sampler
+
+    def sampler_state(self) -> Optional[dict]:
+        """The `smp_*` checkpoint payload: the attached FedSampler's
+        stream state_dict, or None without one."""
+        return (self.data_sampler.state_dict()
+                if self.data_sampler is not None else None)
+
     def _scheduler_active(self) -> bool:
         """True when an attached scheduler can actually produce plans
         (non-default policy) — the scanned path must then run the
@@ -251,7 +272,9 @@ class FedModel:
         progress past the crash."""
         self.fault_schedule = schedule
 
-    def trace_round_programs(self, batch) -> dict:
+    def trace_round_programs(self, batch,
+                             include_span: bool = False,
+                             span_len: int = 2) -> dict:
         """{variant: ClosedJaxpr} of the three single-round programs
         THIS model dispatches — the graftaudit (analysis/audit) hook
         for auditing a real workload rather than the CLI's synthetic
@@ -261,9 +284,15 @@ class FedModel:
         traced body is `round.make_train_fn`'s round_step, i.e. the
         same program the per-round jit AND each scanned-span step
         compile, so what the auditor walks is what run_rounds
-        dispatches."""
+        dispatches.
+
+        include_span=True adds a "span" entry: the scanned
+        `train_rounds` program over `span_len` stacked copies of the
+        batch (round.stack_batch_for_span) — what the mesh tier
+        (graftmesh) prices per-link, here traceable over the real
+        workload/mesh too."""
         from commefficient_tpu.federated.round import (
-            audit_batch_variants,
+            audit_batch_variants, stack_batch_for_span,
         )
         client_ids, data, mask = batch
         rb = fround.RoundBatch(
@@ -280,6 +309,14 @@ class FedModel:
         for variant, vb in audit_batch_variants(rb).items():
             out[variant] = jax.make_jaxpr(self._train_round.round_step)(
                 self.server, self.clients, vb, lr, self._key)
+        if include_span:
+            span = stack_batch_for_span(rb, span_len)
+            # stacking handles both lr avals: [span_len] for the
+            # scalar, [span_len, D] for a per-parameter scale vector
+            lrs = jnp.stack([lr] * span_len)
+            out["span"] = jax.make_jaxpr(
+                self._train_round.train_rounds)(
+                self.server, self.clients, span, lrs, self._key)
         return out
 
     @property
@@ -450,6 +487,13 @@ class FedModel:
             # scheduler counters (sched_* keys) — attach the run's
             # RoundScheduler BEFORE load_state so this lands
             self.scheduler.load_state_dict(ckpt.scheduler)
+        if ckpt.sampler and self.data_sampler is not None:
+            # FedSampler stream state (smp_* keys) — attach the run's
+            # sampler (attach_round_scheduler) BEFORE load_state; the
+            # drivers then consume the restored mid-epoch stream via
+            # sampler.resolve_resume instead of the head-replay
+            # fast-forward
+            self.data_sampler.load_state_dict(ckpt.sampler)
         if ckpt.prev_change_words is not None:
             self._prev_change_words = ckpt.prev_change_words
         # resync the host round mirror so dropout draws / crash points

@@ -236,6 +236,24 @@ def audit_batch_variants(batch: RoundBatch) -> dict:
     }
 
 
+def stack_batch_for_span(batch: RoundBatch, n_rounds: int) -> RoundBatch:
+    """A scanned-span RoundBatch from one single-round batch: every
+    field gains a leading [n_rounds] axis carrying the same round
+    repeated — the treedef `train_rounds` dispatches. Audit hook
+    (graftaudit/graftmesh trace the span program through this;
+    FedModel.trace_round_programs(include_span=True) is the
+    real-workload surface): values never execute, only the
+    shapes/treedef matter."""
+    def stack(x):
+        return None if x is None else jnp.stack([x] * n_rounds)
+    return RoundBatch(
+        stack(batch.client_ids),
+        jax.tree.map(stack, batch.data),
+        stack(batch.mask),
+        stack(batch.survivors),
+        stack(batch.work))
+
+
 def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
                    cfg: Config, mesh: Mesh, grad_mask=None):
     """Build the jitted (train-round, eval) pair. Thin wrapper over the

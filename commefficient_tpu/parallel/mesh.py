@@ -16,6 +16,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from commefficient_tpu.analysis.domains import CLIENTS_AXIS, MODEL_AXIS
+
 
 def make_client_mesh(num_client_shards: Optional[int] = None,
                      devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -24,7 +26,7 @@ def make_client_mesh(num_client_shards: Optional[int] = None,
     n = num_client_shards or len(devices)
     if n > len(devices):
         raise ValueError(f"asked for {n} shards, have {len(devices)} devices")
-    return Mesh(np.asarray(devices[:n]), axis_names=("clients",))
+    return Mesh(np.asarray(devices[:n]), axis_names=(CLIENTS_AXIS,))
 
 
 def make_client_model_mesh(num_client_shards: int, model_parallel: int,
@@ -36,7 +38,7 @@ def make_client_model_mesh(num_client_shards: int, model_parallel: int,
     if need > len(devices):
         raise ValueError(f"need {need} devices, have {len(devices)}")
     arr = np.asarray(devices[:need]).reshape(num_client_shards, model_parallel)
-    return Mesh(arr, axis_names=("clients", "model"))
+    return Mesh(arr, axis_names=(CLIENTS_AXIS, MODEL_AXIS))
 
 
 def slice_balanced_prefix(devices: Sequence[jax.Device],
@@ -134,5 +136,5 @@ def make_multihost_client_mesh(model_parallel: int = 1,
         order = np.argsort([i % n_sl for i in range(n)], kind="stable")
         arr = np.asarray(devices)[order].reshape(clients, model_parallel)
     if model_parallel == 1:
-        return Mesh(arr.reshape(-1), axis_names=("clients",))
-    return Mesh(arr, axis_names=("clients", "model"))
+        return Mesh(arr.reshape(-1), axis_names=(CLIENTS_AXIS,))
+    return Mesh(arr, axis_names=(CLIENTS_AXIS, MODEL_AXIS))

@@ -78,6 +78,8 @@ def _make_model_and_rules(variant: str):
     import flax.linen as nn
     from jax.sharding import PartitionSpec as P
 
+    from commefficient_tpu.analysis.domains import MODEL_AXIS
+
     if variant == "tp":
         class TpMLP(nn.Module):
             """Megatron-style two-matmul sandwich: column-parallel up
@@ -91,9 +93,9 @@ def _make_model_and_rules(variant: str):
                 return nn.Dense(10, name="head")(h)
 
         rules = (
-            (r"up/kernel$", P(None, "model")),
-            (r"up/bias$", P("model")),
-            (r"down/kernel$", P("model", None)),
+            (r"up/kernel$", P(None, MODEL_AXIS)),
+            (r"up/bias$", P(MODEL_AXIS)),
+            (r"down/kernel$", P(MODEL_AXIS, None)),
         )
         return TpMLP(), rules, np.zeros((B, 12), np.float32)
 

@@ -42,6 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from commefficient_tpu.analysis.domains import CLIENTS_AXIS
+
 _initialized = False
 
 
@@ -193,7 +195,7 @@ def shard_rows(mesh: Mesh, local_rows, leading_axes: int = 0) -> jax.Array:
     (the scanned multi-round span's ``[N, W_local, ...]``).
 
     Single-process: device_put of the (already global) rows."""
-    spec = P(*([None] * leading_axes), "clients",
+    spec = P(*([None] * leading_axes), CLIENTS_AXIS,
              *([None] * (np.ndim(local_rows) - leading_axes - 1)))
     sharding = NamedSharding(mesh, spec)
     if not is_multihost():
@@ -264,10 +266,10 @@ def _clients_axis_devices(mesh: Mesh):
     via contiguity of the flattened list)."""
     axes = list(mesh.axis_names)
     arr = mesh.devices
-    if axes == ["clients"]:
+    if axes == [CLIENTS_AXIS]:
         return list(arr.reshape(-1))
     # move the clients axis first, take the first element of the rest
-    k = axes.index("clients")
+    k = axes.index(CLIENTS_AXIS)
     arr = np.moveaxis(arr, k, 0)
     return list(arr.reshape(arr.shape[0], -1)[:, 0])
 
@@ -294,7 +296,7 @@ def tile_rows(mesh: Mesh, vec, rows: int) -> jax.Array:
     download-top-k path. Shard-local materialization only."""
     host = np.asarray(vec)
     shape = (rows, host.shape[0])
-    sharding = NamedSharding(mesh, P("clients", None))
+    sharding = NamedSharding(mesh, P(CLIENTS_AXIS, None))
     if not is_multihost():
         # np.broadcast_to + explicit device_put — see globalize
         return jax.device_put(np.broadcast_to(host, shape), sharding)

@@ -12,12 +12,12 @@ all-reduces where the Megatron-style kernel layout requires them.
 
 Layout (the standard two-matmul sandwich per block):
   * column-parallel first matmuls — QKV projection [E, 3E] and MLP
-    up-projection [E, 4E] sharded P(None, "model"), their biases
-    P("model") — each shard computes a slice of heads / hidden units;
+    up-projection [E, 4E] sharded P(None, MODEL_AXIS), their biases
+    P(MODEL_AXIS) — each shard computes a slice of heads / hidden units;
   * row-parallel second matmuls — attention/MLP output projections
-    sharded P("model", None) — partial products all-reduced by GSPMD;
+    sharded P(MODEL_AXIS, None) — partial products all-reduced by GSPMD;
   * the (tied) token embedding [V, E] sharded over the vocab axis
-    P("model", None); `attend` logits are likewise reduced by GSPMD.
+    P(MODEL_AXIS, None); `attend` logits are likewise reduced by GSPMD.
 
 Usage (workload level — the engine is workload-agnostic):
     params = constrain_params(params, mesh, GPT2_TP_RULES)  # in loss_fn
@@ -31,19 +31,20 @@ import jax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from commefficient_tpu.analysis.domains import MODEL_AXIS
 from commefficient_tpu.parallel import compat
 
 # (path regex, spec) — first match wins; unmatched leaves replicate.
 # Paths are "/"-joined pytree key paths, e.g.
 # "params/transformer/h_3/attn/c_attn/kernel".
 GPT2_TP_RULES: Sequence[Tuple[str, P]] = (
-    (r"attn/c_attn/kernel$", P(None, "model")),
-    (r"attn/c_attn/bias$", P("model")),
-    (r"attn/c_proj/kernel$", P("model", None)),
-    (r"mlp/c_fc/kernel$", P(None, "model")),
-    (r"mlp/c_fc/bias$", P("model")),
-    (r"mlp/c_proj/kernel$", P("model", None)),
-    (r"wte/embedding$", P("model", None)),
+    (r"attn/c_attn/kernel$", P(None, MODEL_AXIS)),
+    (r"attn/c_attn/bias$", P(MODEL_AXIS)),
+    (r"attn/c_proj/kernel$", P(MODEL_AXIS, None)),
+    (r"mlp/c_fc/kernel$", P(None, MODEL_AXIS)),
+    (r"mlp/c_fc/bias$", P(MODEL_AXIS)),
+    (r"mlp/c_proj/kernel$", P(MODEL_AXIS, None)),
+    (r"wte/embedding$", P(MODEL_AXIS, None)),
 )
 
 
@@ -66,7 +67,7 @@ def constrain_params(params, mesh: Mesh,
     # Manual (and params arrive clients-varying via pcast), which the
     # concrete mesh — all-Auto axis types — cannot describe
     am = compat.abstract_mesh()
-    target = am if am is not None and "model" in am.axis_names else mesh
+    target = am if am is not None and MODEL_AXIS in am.axis_names else mesh
 
     def constrain(path, leaf):
         s = _path_str(path)
@@ -83,7 +84,7 @@ def tp_loss(loss_fn: Callable, mesh: Mesh,
             rules: Sequence[Tuple[str, P]] = GPT2_TP_RULES) -> Callable:
     """Wrap a loss_fn(params, batch, mask) so its parameters carry the
     tensor-parallel layout before the model runs."""
-    if "model" not in mesh.axis_names:
+    if MODEL_AXIS not in mesh.axis_names:
         return loss_fn
 
     def wrapped(params, batch, mask):

@@ -37,17 +37,16 @@ Invariants:
     `sched_*` (like the tracker's `thr_*`); selection/deadline math is
     a pure function of (seed, round_idx, tracker state), and the
     tracker is checkpoint-restored bit-exactly, so a resumed run
-    replays the identical post-checkpoint decisions. Scope caveat for
-    the MID-EPOCH fast-forward under NON-uniform sampling: the
-    skipped head's selections replay against the checkpoint-time
-    tracker (their historical tracker states are gone), so the
-    re-drawn head — and therefore the sampler's data cursors — can
-    differ from the pre-crash timeline. Restored state and
-    post-checkpoint decisions stay exact; which future data chunks
-    the resumed epoch feeds may not match the counterfactual
-    uninterrupted run (uniform, the default, replays the head
-    bit-identically — its draws ignore the tracker). Checkpointing
-    the sampler cursor state is the named ROADMAP opening.
+    replays the identical post-checkpoint decisions. Since ISSUE 8
+    the SAMPLER's stream state (rng + mid-epoch cursor/permutations,
+    data/sampler.py state_dict, `smp_*` checkpoint keys) rides along
+    too: a non-uniform mid-epoch resume CONTINUES the exact data
+    stream instead of replaying the epoch head against the
+    checkpoint-time tracker — the old scope caveat (re-drawn head →
+    diverged data cursors) is closed, proven stream-bit-exact in
+    tests/test_sampler_resume.py. Legacy checkpoints without smp_*
+    keep the replay fast-forward path (bit-exact for uniform, the
+    default).
   * SINGLE-CONTROLLER ONLY for non-default policies: tracker rates
     derive from process-local wall clocks and would diverge across
     controllers (Config.validate rejects the combination; the
@@ -276,4 +275,9 @@ def attach_round_scheduler(model, train_loader) -> RoundScheduler:
                            model.throughput)
     train_loader.sampler.scheduler = sched
     model.attach_scheduler(sched)
+    # the sampler itself rides along so its stream state (rng +
+    # mid-epoch cursor/permutations, smp_* checkpoint keys) is saved
+    # and restored with the model — the exact-data-stream resume
+    # contract for non-uniform sampling
+    model.attach_data_sampler(train_loader.sampler)
     return sched

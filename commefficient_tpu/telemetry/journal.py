@@ -52,6 +52,11 @@ consumers must tolerate kinds they don't know):
                           (analysis/audit): sha256 `digest`,
                           per-program `programs` {flops, hbm_bytes},
                           the traced `geometry`, and the finding count
+  mesh_audit_digest       graftmesh's per-link collective report
+                          (analysis/shardaudit): sha256 `digest`,
+                          per-program `programs` {ici_bytes,
+                          dcn_bytes, dcn_collectives}, the `meshes`
+                          link models, geometry, finding count
 """
 from __future__ import annotations
 
@@ -219,7 +224,11 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
         non-empty string `digest` and a `programs` object mapping each
         audited program to non-negative numeric flops/hbm_bytes — the
         record a cost-regression investigation greps for, so its shape
-        must not rot.
+        must not rot;
+      * `mesh_audit_digest` events (graftmesh per-link reports) carry
+        the same digest/programs shape with non-negative numeric
+        ici_bytes/dcn_bytes/dcn_collectives per program — the record
+        the million-client refactor's before/after comm table reads.
 
     A `run_start` event opens a new run SEGMENT and resets the round
     tracking: a preempted run resumed with the same --journal_path
@@ -272,30 +281,38 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
             for field in ("deadline_s", "est_round_s",
                           "expected_round_s"):
                 _comm_field(rec, n, field)
-        if rec.get("event") == "audit_digest":
+        # the two analysis-tier digest records share a shape: sha256
+        # digest + per-program cost object, with tier-specific fields
+        digest_fields = {
+            "audit_digest": ("flops", "hbm_bytes"),
+            "mesh_audit_digest": ("ici_bytes", "dcn_bytes",
+                                  "dcn_collectives"),
+        }
+        ev = rec.get("event")
+        if ev in digest_fields:
             d = rec.get("digest")
             if not (isinstance(d, str) and d):
                 problems.append(
-                    f"record {n}: audit_digest without a non-empty "
+                    f"record {n}: {ev} without a non-empty "
                     f"string `digest` (got {d!r})")
             progs = rec.get("programs")
             if not isinstance(progs, dict):
                 problems.append(
-                    f"record {n}: audit_digest `programs` is not an "
+                    f"record {n}: {ev} `programs` is not an "
                     "object")
             else:
                 for prog, cost in sorted(progs.items()):
                     if not isinstance(cost, dict):
                         problems.append(
-                            f"record {n}: audit_digest program "
+                            f"record {n}: {ev} program "
                             f"{prog!r} cost is not an object")
                         continue
-                    for field in ("flops", "hbm_bytes"):
+                    for field in digest_fields[ev]:
                         v2 = cost.get(field)
                         if not (isinstance(v2, (int, float))
                                 and v2 >= 0):
                             problems.append(
-                                f"record {n}: audit_digest program "
+                                f"record {n}: {ev} program "
                                 f"{prog!r} `{field}` must be a "
                                 f"non-negative number (got {v2!r})")
         if rec.get("event") == "run_end":

@@ -157,8 +157,25 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
     # mid-epoch resume: fast-forward the first resumed epoch's stream
     # past the rounds already trained — sampler index math only, no
     # batch materialization (FedLoader.epoch(skip=); symmetric with
-    # gpt2_train's fast-forward)
-    skip_rounds = rounds_done % spe
+    # gpt2_train's fast-forward). With checkpointed sampler state
+    # (smp_* keys restored by model.load_state), resolve_resume
+    # collapses the skip to 0: the restored cursor CONTINUES the
+    # stream exactly, so non-uniform sampling resumes onto the same
+    # data the uninterrupted run would have fed.
+    skip_rounds = train_loader.sampler.resolve_resume(
+        rounds_done % spe)
+    # restored mid-epoch stream: the uninterrupted run caps every
+    # epoch at spe rounds, so a stream restored AT the cap was
+    # abandoned right there (discard — the restored rng is all a
+    # fresh epoch needs), and one restored short of the cap may only
+    # be driven for the REMAINING spe - pos rounds (the scanned
+    # epoch_rounds budget below subtracts resumed_pos; without the
+    # subtraction a resumed epoch would overrun the cap on the same
+    # permutation)
+    resumed_pos = train_loader.sampler.pending_pos or 0
+    if resumed_pos >= spe:
+        train_loader.sampler.discard_pending()
+        resumed_pos = 0
     total_down = np.zeros(model.num_clients)
     total_up = np.zeros(model.num_clients)
 
@@ -176,7 +193,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             jax.profiler.start_trace(
                 os.path.join(log_dir or ".", "profile"))
             profiling = profiled = True
-        epoch_rounds = min(spe, total_rounds - rounds_done)
+        epoch_rounds = min(spe - resumed_pos,
+                           total_rounds - rounds_done)
         if model.scheduler is not None:
             # sync the scheduler's round counter to the stream about
             # to be drawn: the resumed first epoch replays (and
@@ -185,6 +203,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             model.scheduler.begin_epoch(rounds_done - skip_rounds)
         epoch_stream = train_loader.epoch(skip=skip_rounds)
         skip_rounds = 0
+        resumed_pos = 0
         losses, accs = [], []
         down = np.zeros(model.num_clients)
         up = np.zeros(model.num_clients)
@@ -212,14 +231,26 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
 
             def stream():
+                # cap-BEFORE-pull: the epoch budget is checked before
+                # drawing the next round, so ending an epoch never
+                # draws-and-discards a round (a phantom rng advance no
+                # resume could reproduce), and the abandonment mark
+                # lands before any checkpoint that follows — a resume
+                # from the epoch's last span checkpoint (pos == cap)
+                # discards the restored stream exactly where this run
+                # abandons it
                 nonlocal taken
-                for client_ids, data, mask in epoch_stream:
-                    if taken == epoch_rounds:
+                stream_it = iter(epoch_stream)
+                while taken < epoch_rounds:
+                    try:
+                        client_ids, data, mask = next(stream_it)
+                    except StopIteration:
                         return
                     lr_scheduler.step()
                     taken += 1
                     lr = opt.param_groups[0]["lr"]
                     yield (lr, client_ids, data, mask, lr)
+                train_loader.sampler.abandon_epoch()
 
             def on_flush(n_rounds):
                 amortized[0] = (_now() - step_t0[0]) / max(n_rounds, 1)
@@ -265,8 +296,17 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 return not np.isnan(losses[-1])
 
             pending = None
-            for client_ids, data, mask in epoch_stream:
+            stream_it = iter(epoch_stream)
+            while True:
                 if rounds_done >= total_rounds:
+                    # round budget reached mid-stream: abandon
+                    # WITHOUT pulling (see the scanned cap above) so
+                    # any later checkpoint records in_epoch=0
+                    train_loader.sampler.abandon_epoch()
+                    break
+                try:
+                    client_ids, data, mask = next(stream_it)
+                except StopIteration:
                     break
                 lr_scheduler.step()
                 # first dispatch of the process compiles; every later
@@ -357,7 +397,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 prev_change_words=model._prev_change_words,
                 fingerprint=model.checkpoint_fingerprint,
                 throughput=model.throughput.state_dict(),
-                scheduler=model.scheduler_state())
+                scheduler=model.scheduler_state(),
+                sampler=model.sampler_state())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
                     "checkpoint", path=path,
@@ -538,7 +579,8 @@ def main(argv=None) -> bool:
                 prev_change_words=model._prev_change_words,
                 fingerprint=model.checkpoint_fingerprint,
                 throughput=model.throughput.state_dict(),
-                scheduler=model.scheduler_state())
+                scheduler=model.scheduler_state(),
+                sampler=model.sampler_state())
             if coord:
                 print(f"saved checkpoint to {path}")
     finally:

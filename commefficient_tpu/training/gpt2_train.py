@@ -177,8 +177,19 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
     # stream past the rounds already trained, so the epoch's early
     # batches aren't re-trained while batch_idx continues mid-epoch
     # (data coverage matches an uninterrupted run up to the sampler's
-    # fresh permutation; LR schedule and budget were already correct)
-    skip_rounds = batch_idx % spe
+    # fresh permutation; LR schedule and budget were already correct).
+    # With checkpointed sampler state (smp_* keys) resolve_resume
+    # collapses the skip to 0 and the restored cursor continues the
+    # exact stream — same contract as cv_train.train.
+    skip_rounds = train_loader.sampler.resolve_resume(
+        batch_idx % spe)
+    # a stream restored AT the per-epoch cap was abandoned right
+    # there by the uninterrupted run — discard it so the resumed
+    # epoch draws fresh (cv_train applies the same rule; here the
+    # absolute batch_idx cap already bounds the remainder, so no
+    # budget subtraction is needed)
+    if (train_loader.sampler.pending_pos or 0) >= spe:
+        train_loader.sampler.discard_pending()
     ckpt_path = os.path.join(cfg.checkpoint_path, "gpt2")
 
     if cfg.do_profile:
@@ -226,15 +237,23 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
             # scanned device programs, flushed every --scan_span rounds
             # (symmetric with cv_train; bounds the staged token arrays)
             def stream():
+                # cap-BEFORE-pull: never draw-and-discard a round at
+                # the epoch cap, and mark the abandonment before any
+                # checkpoint that follows (same contract as
+                # cv_train's scanned stream)
                 nonlocal batch_idx
-                for client_ids, data, mask in epoch_stream:
-                    if batch_idx - epoch * spe >= spe * frac:
+                stream_it = iter(epoch_stream)
+                while batch_idx - epoch * spe < spe * frac:
+                    try:
+                        client_ids, data, mask = next(stream_it)
+                    except StopIteration:
                         return
                     lr_scheduler.step()
                     batch_idx += 1
                     lr_v = opt.param_groups[0]["lr"]
                     yield ((batch_idx, float(lr_v)), client_ids, data,
                            mask, lr_v)
+                train_loader.sampler.abandon_epoch()
 
             def on_comm(d, u):
                 nonlocal epoch_download, epoch_upload
@@ -254,8 +273,17 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                     ckpt_path, model, cfg, lr_scheduler),
                 guard=guard)
         else:
-            for client_ids, data, mask in epoch_stream:
+            stream_it = iter(epoch_stream)
+            while True:
                 if batch_idx - epoch * spe >= spe * frac:
+                    # epoch cap: abandon WITHOUT pulling — the epoch-
+                    # cadence checkpoint below must record in_epoch=0
+                    # and no phantom draw may advance the rng
+                    train_loader.sampler.abandon_epoch()
+                    break
+                try:
+                    client_ids, data, mask = next(stream_it)
+                except StopIteration:
                     break
                 lr_scheduler.step()
                 ctx = (guard() if guard is not None and warmed[0]
@@ -317,7 +345,8 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 prev_change_words=model._prev_change_words,
                 fingerprint=model.checkpoint_fingerprint,
                 throughput=model.throughput.state_dict(),
-                scheduler=model.scheduler_state())
+                scheduler=model.scheduler_state(),
+                sampler=model.sampler_state())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
                     "checkpoint", path=written,
@@ -559,7 +588,8 @@ def main(argv=None) -> bool:
                            prev_change_words=model._prev_change_words,
                            fingerprint=model.checkpoint_fingerprint,
                            throughput=model.throughput.state_dict(),
-                           scheduler=model.scheduler_state())
+                           scheduler=model.scheduler_state(),
+                           sampler=model.sampler_state())
             # HF-style final artifact: tokenizer + config + weights
             # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
             if coord:

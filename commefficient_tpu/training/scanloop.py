@@ -143,7 +143,8 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
             prev_change_words=model._prev_change_words,
             fingerprint=model.checkpoint_fingerprint,
             throughput=model.throughput.state_dict(),
-            scheduler=model.scheduler_state())
+            scheduler=model.scheduler_state(),
+            sampler=model.sampler_state())
         tele = getattr(model, "telemetry", None)
         if tele is not None:
             # the save is a full state gather + disk write — exactly

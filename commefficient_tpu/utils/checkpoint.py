@@ -100,6 +100,11 @@ class Checkpoint(NamedTuple):
     fingerprint: Optional[dict] = None
     throughput: Optional[dict] = None
     scheduler: Optional[dict] = None
+    # FedSampler stream state (data/sampler.py state_dict, `smp_*`
+    # keys): rng + mid-epoch cursor/permutations, so a non-uniform
+    # (throughput-aware) mid-epoch resume replays the exact same data
+    # stream instead of re-drawing the epoch head
+    sampler: Optional[dict] = None
 
 
 def save_checkpoint(path: str, server: ServerState,
@@ -111,7 +116,8 @@ def save_checkpoint(path: str, server: ServerState,
                     chunk_rows: int = 256,
                     fingerprint: Optional[dict] = None,
                     throughput: Optional[dict] = None,
-                    scheduler: Optional[dict] = None) -> str:
+                    scheduler: Optional[dict] = None,
+                    sampler: Optional[dict] = None) -> str:
     """Write training state to `path` (.npz appended if absent).
     Per-client state can be excluded (include_clients=False) to keep
     files small when clients are stateless (error_type != local and
@@ -162,6 +168,12 @@ def save_checkpoint(path: str, server: ServerState,
         # state_dict()); same bit-exact-resume contract as thr_*
         for k, v in scheduler.items():
             arrays[f"sched_{k}"] = np.asarray(v)
+    if sampler is not None:
+        # FedSampler stream state (data/sampler.py state_dict());
+        # restores the exact mid-epoch data stream under non-uniform
+        # sampling — same bit-exact-resume contract as thr_*/sched_*
+        for k, v in sampler.items():
+            arrays[f"smp_{k}"] = np.asarray(v)
     if fingerprint is not None:
         for k in FINGERPRINT_FIELDS:
             arrays[f"fp_{k}"] = np.asarray(str(fingerprint[k]))
@@ -246,9 +258,11 @@ def load_checkpoint(path: str,
            if k.startswith("thr_")}
     sched = {k[len("sched_"):]: z[k] for k in z.files
              if k.startswith("sched_")}
+    smp = {k[len("smp_"):]: z[k] for k in z.files
+           if k.startswith("smp_")}
     return Checkpoint(server, clients, int(z["scheduler_step"]),
                       acct or None, prev, fingerprint, thr or None,
-                      sched or None)
+                      sched or None, smp or None)
 
 
 # ---------------- keep-last-k rotation + latest manifest -----------------

@@ -2,7 +2,13 @@
 # graftaudit: the repo's jaxpr-level program audit (rules AU001-AU006,
 # see README "Program auditing"). Runs from any cwd; extra args pass
 # through (e.g. `bash scripts/audit.sh --report`, `--list-rules`,
-# `--write-baseline`).
+# `--write-baseline`). With `--mesh` (or `--list-meshes`) it runs the
+# mesh-aware third tier instead — graftmesh, rules AU007-AU011 + the
+# per-link ICI/DCN baseline — which forces the 8-device simulated
+# host platform itself before importing jax.
+#
+# Exit codes (both tiers): 0 clean, 1 rule violations, 2 baseline
+# drift only (regenerate with --write-baseline and commit the diff).
 #
 # Unlike graftlint this pass IMPORTS jax (it traces the round
 # programs), so it pins JAX_PLATFORMS=cpu — tracing needs no

@@ -22,6 +22,21 @@ timeout -k 10 300 bash "$(dirname "$0")/audit.sh" --journal "$AJR" \
     || { echo "GRAFTAUDIT_FAILED"; exit 1; }
 python scripts/journal_summary.py "$AJR" \
     || { echo "AUDIT_JOURNAL_INVALID"; exit 1; }
+# mesh audit third (ISSUE 8): trace the round programs + scanned span
+# under the simulated 8-device meshes (1-D clients, 2-D clients x
+# model, emulated 2-slice) and check the sharding/collective contracts
+# (AU007-AU011) plus the per-link ICI/DCN byte report against
+# meshaudit.baseline.json. Exit 1 = contract violation, 2 = baseline
+# drift; either fails tier-1. Its mesh_audit_digest is journaled and
+# the journal must validate.
+MJR=/tmp/_t1_meshaudit.jsonl
+rm -f "$MJR"
+timeout -k 10 300 env \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    bash "$(dirname "$0")/audit.sh" --mesh --journal "$MJR" \
+    || { echo "GRAFTMESH_FAILED"; exit 1; }
+python scripts/journal_summary.py "$MJR" \
+    || { echo "MESH_JOURNAL_INVALID"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

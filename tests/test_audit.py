@@ -252,6 +252,25 @@ def test_au006_cost_drift_new_and_stale_fire(full_audit):
                for v in baseline.apply_costs(missing, tolerance=0.0))
 
 
+def test_exit_code_split_violations_vs_drift():
+    """ISSUE 8 satellite: graftaudit shares graftmesh's exit-code
+    contract — rule violations exit 1, baseline drift (AU006 / stale
+    entries) exits 2 — so CI can route 'program broke a contract' and
+    're-commit the baseline' differently."""
+    from commefficient_tpu.analysis.shardaudit import (
+        exit_code, split_findings,
+    )
+
+    rule_hit = A.AuditFinding("p/x", "AU002", "f64")
+    drift_hit = A.AuditFinding("p/x", "AU006", "cost moved")
+    assert split_findings([rule_hit, drift_hit]) == ([rule_hit],
+                                                     [drift_hit])
+    assert exit_code([rule_hit], [drift_hit], []) == 1
+    assert exit_code([], [drift_hit], []) == 2
+    assert exit_code([], [], ["stale entry"]) == 2
+    assert exit_code([], [], []) == 0
+
+
 def test_audit_digest_journal_schema(full_audit, tmp_path):
     from commefficient_tpu.telemetry.journal import (
         append_event, validate_journal,

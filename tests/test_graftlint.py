@@ -231,6 +231,38 @@ GL009_NEG = """
         return jax.random.fold_in(key, 7), jax.random.fold_in(key, i)
 """
 
+GL010_POS = """
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    def make_mesh(devices):
+        # "cleints" is the typo class the registry exists to catch
+        return Mesh(np.asarray(devices), axis_names=("cleints",))
+
+    def spec_for(mesh):
+        return NamedSharding(mesh, P("batch", None))
+"""
+GL010_NEG = """
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from commefficient_tpu.analysis.domains import CLIENTS_AXIS
+
+    def make_mesh(devices):
+        # registry constants and registry-VALUED literals are both
+        # clean (the rule checks by value)
+        return Mesh(np.asarray(devices), axis_names=(CLIENTS_AXIS,))
+
+    def spec_for(mesh):
+        return NamedSharding(mesh, P("clients", "model"))
+
+    def device_label(x):
+        # non-axis strings outside sharding sinks are out of scope
+        return str(x) + "tpu:0"
+"""
+
+# rule -> (positive, negative[, lint path]); GL010 is path-scoped to
+# the packages that construct shardings, so its fixtures lint under a
+# parallel/ path (everything else uses the default snippet.py)
 FIXTURES = {
     "GL001": (GL001_POS, GL001_NEG),
     "GL002": (GL002_POS, GL002_NEG),
@@ -241,6 +273,8 @@ FIXTURES = {
     "GL007": (GL007_POS, GL007_NEG),
     "GL008": (GL008_POS, GL008_NEG),
     "GL009": (GL009_POS, GL009_NEG),
+    "GL010": (GL010_POS, GL010_NEG,
+              "commefficient_tpu/parallel/snippet.py"),
 }
 
 
@@ -273,16 +307,59 @@ def test_gl009_shipped_registry_is_unique():
     assert DOMAINS["sampler"] == 0x5C4ED
 
 
+def _fixture_codes(src: str, path: str = "snippet.py"):
+    return sorted({v.rule for v in lint_source(path,
+                                               textwrap.dedent(src))})
+
+
 @pytest.mark.parametrize("rule", sorted(ALL_RULES))
 def test_rule_fires_on_positive_fixture(rule):
-    pos, _ = FIXTURES[rule]
-    assert rule in codes(pos), f"{rule} failed to fire on its fixture"
+    pos, _, *path = FIXTURES[rule]
+    assert rule in _fixture_codes(pos, *path), \
+        f"{rule} failed to fire on its fixture"
 
 
 @pytest.mark.parametrize("rule", sorted(ALL_RULES))
 def test_rule_quiet_on_negative_fixture(rule):
-    _, neg = FIXTURES[rule]
-    assert rule not in codes(neg), f"{rule} false-positived"
+    _, neg, *path = FIXTURES[rule]
+    assert rule not in _fixture_codes(neg, *path), \
+        f"{rule} false-positived"
+
+
+def test_gl010_scoped_to_sharding_packages():
+    """The same unregistered-axis source OUTSIDE parallel//federated/
+    is not GL010's business (workload-specific meshes in tests or
+    models name their own axes)."""
+    assert "GL010" not in _fixture_codes(GL010_POS)
+    assert "GL010" in _fixture_codes(
+        GL010_POS, "commefficient_tpu/federated/snippet.py")
+
+
+def test_gl010_shard_map_mesh_argument_not_scanned():
+    """shard_map's positional slot 1 is the MESH expression — string
+    literals inside it (a registry lookup key, a label) are not axis
+    names and must not false-positive; the axis_names KWARG is the
+    sink."""
+    src = """
+        from commefficient_tpu.parallel.compat import shard_map
+
+        def wire(f, registry, specs):
+            return shard_map(f, registry.lookup("emu2"), *specs)
+
+        def bad(f, mesh, specs):
+            return shard_map(f, mesh, *specs,
+                             axis_names=frozenset({"cleints"}))
+    """
+    hits = _fixture_codes(src, "commefficient_tpu/parallel/snip.py")
+    assert hits == ["GL010"]
+
+
+def test_gl010_shipped_registry():
+    from commefficient_tpu.analysis.domains import (
+        CLIENTS_AXIS, MESH_AXES, MODEL_AXIS,
+    )
+    assert MESH_AXES == (CLIENTS_AXIS, MODEL_AXIS) == ("clients",
+                                                       "model")
 
 
 def test_every_rule_documented():

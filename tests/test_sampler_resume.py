@@ -1,0 +1,406 @@
+"""FedSampler stream checkpointing (ISSUE 8 satellite — the named
+PR-5 opening): a mid-epoch resume must CONTINUE the exact data stream,
+not replay the epoch head. Under uniform sampling the old replay
+fast-forward was already bit-exact (draws ignore the tracker); under
+THROUGHPUT-AWARE sampling the head replay re-drew selections against
+the checkpoint-time tracker, so the resumed run's future data stream
+could diverge from the uninterrupted timeline. With the sampler's rng
++ cursor + permutations in the checkpoint (smp_* keys), the stream is
+a pure function of restored state and the divergence is gone.
+
+Proven here at three levels: the bare sampler, the full
+sampler+scheduler+tracker stack through a REAL .npz checkpoint
+round-trip (crash -> resume), and the FedModel attach/restore
+plumbing the drivers use.
+"""
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.data.sampler import FedSampler
+from commefficient_tpu.scheduler import RoundScheduler
+from commefficient_tpu.telemetry.clients import ClientThroughputTracker
+from commefficient_tpu.utils.checkpoint import (
+    load_checkpoint, save_checkpoint,
+)
+
+N_CLIENTS = 12
+W = 4
+B = 3
+DPC = np.array([7, 5, 9, 6, 8, 5, 7, 6, 9, 8, 7, 9])
+
+
+def drain(sampler, n):
+    """Draw `n` rounds across epoch boundaries (fresh epoch() per
+    exhaustion), the way the drivers' epoch loops do."""
+    out, gen = [], None
+    while len(out) < n:
+        if gen is None:
+            gen = sampler.epoch()
+        try:
+            out.append(next(gen))
+        except StopIteration:
+            gen = None
+    return out
+
+
+def assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for i, (r1, r2) in enumerate(zip(a, b)):
+        assert np.array_equal(r1.client_ids, r2.client_ids), i
+        assert np.array_equal(r1.idx_within, r2.idx_within), i
+        assert np.array_equal(r1.mask, r2.mask), i
+
+
+# ---------------------------------------------------------------------------
+# bare sampler
+
+
+def test_mid_epoch_state_roundtrip_is_stream_bit_exact():
+    ref = FedSampler(DPC, W, B, seed=7)
+    reference = drain(ref, 14)
+
+    crashed = FedSampler(DPC, W, B, seed=7)
+    head = drain(crashed, 5)                 # crash mid-epoch
+    state = crashed.state_dict()
+    assert int(state["in_epoch"]) == 1
+
+    resumed = FedSampler(DPC, W, B, seed=7)
+    resumed.load_state_dict(state)
+    assert resumed.resume_pending
+    assert resumed.resolve_resume(5) == 0    # continue, don't replay
+    tail = drain(resumed, 9)
+    assert_streams_equal(reference, head + tail)
+
+
+def test_epoch_boundary_state_discards_pending():
+    """A resume landing ON an epoch boundary starts a fresh epoch from
+    the restored rng — matching the uninterrupted run, which abandoned
+    the old stream."""
+    ref = FedSampler(DPC, W, B, seed=3)
+    gen = ref.epoch()
+    while True:
+        try:
+            next(gen)
+        except StopIteration:
+            break
+    state = ref.state_dict()
+    assert int(state["in_epoch"]) == 0
+    after_ref = drain(ref, 4)
+
+    resumed = FedSampler(DPC, W, B, seed=3)
+    resumed.load_state_dict(state)
+    assert resumed.resolve_resume(0) == 0
+    assert not resumed.resume_pending
+    assert_streams_equal(after_ref, drain(resumed, 4))
+
+
+def test_resolve_resume_is_identity_without_state():
+    """Legacy checkpoints (no smp_* keys) keep the replay
+    fast-forward path untouched."""
+    s = FedSampler(DPC, W, B, seed=0)
+    assert s.resolve_resume(5) == 5
+    assert s.resolve_resume(0) == 0
+
+
+def test_abandon_epoch_marks_checkpoint_fresh():
+    """The drivers cap each epoch's stream at their own round budget
+    and ABANDON the suspended generator; they signal that via
+    abandon_epoch before checkpointing, so the saved state says
+    in_epoch=0 and a resume opens a fresh epoch — matching the
+    uninterrupted timeline — even when the cap left rounds_done off
+    the steps_per_epoch modulus (real epoch lengths drift from the
+    estimate)."""
+    ref = FedSampler(DPC, W, B, seed=9)
+    gen = ref.epoch()
+    for _ in range(4):
+        next(gen)
+    next(gen)                    # the driver's pull-then-discard
+    ref.abandon_epoch()          # driver cap: stream is over
+    state = ref.state_dict()
+    assert int(state["in_epoch"]) == 0
+    after_ref = drain(ref, 5)    # uninterrupted: fresh epoch
+
+    resumed = FedSampler(DPC, W, B, seed=9)
+    resumed.load_state_dict(state)
+    assert not resumed.resume_pending
+    # rounds_done was NOT a multiple of spe here — irrelevant: the
+    # checkpoint itself says "fresh epoch", and skip collapses to 0
+    assert resumed.resolve_resume(5) == 0
+    assert_streams_equal(after_ref, drain(resumed, 5))
+
+
+def test_mid_epoch_pending_survives_zero_skip():
+    """A live mid-epoch checkpoint resumes the stream even when the
+    driver's spe estimate happens to put rounds_done on an epoch
+    boundary (estimate drift): in_epoch in the checkpoint — not the
+    modulus — decides."""
+    reference = drain(FedSampler(DPC, W, B, seed=13), 9)
+
+    crashed = FedSampler(DPC, W, B, seed=13)
+    drain(crashed, 4)
+    state = crashed.state_dict()
+    assert int(state["in_epoch"]) == 1
+
+    resumed = FedSampler(DPC, W, B, seed=13)
+    resumed.load_state_dict(state)
+    assert resumed.resolve_resume(0) == 0
+    assert resumed.resume_pending   # NOT discarded by the 0 skip
+    assert_streams_equal(reference[4:], drain(resumed, 5))
+
+
+def _capped_epoch(sampler, cap, collect):
+    """The drivers' scanned-stream protocol: pull at most `cap`
+    rounds of one epoch (cap checked BEFORE each pull — no round is
+    ever drawn and discarded), then mark abandonment. Returns rounds
+    actually drawn (< cap when the stream exhausts first)."""
+    gen = sampler.epoch()
+    drawn = 0
+    while drawn < cap:
+        try:
+            collect.append(next(gen))
+        except StopIteration:
+            return drawn
+        drawn += 1
+    sampler.abandon_epoch()
+    return drawn
+
+
+def test_resume_from_at_cap_checkpoint_matches_abandonment():
+    """Crash window between an epoch's LAST span checkpoint (stream
+    live, pos == cap) and the next save: the uninterrupted run
+    abandons the stream right after that checkpoint without drawing
+    anything further, so a resume that discards the restored at-cap
+    stream (the drivers' pending_pos >= spe rule) replays the next
+    epoch bit-exactly."""
+    CAP = 5  # < real stream length, so the stream is live at the cap
+
+    ref = FedSampler(DPC, W, B, seed=17)
+    ref_rounds = []
+    assert _capped_epoch(ref, CAP, ref_rounds) == CAP
+    ref_next = []
+    _capped_epoch(ref, CAP, ref_next)        # the next epoch
+
+    crashed = FedSampler(DPC, W, B, seed=17)
+    rounds = []
+    gen = crashed.epoch()
+    for _ in range(CAP):
+        rounds.append(next(gen))
+    state = crashed.state_dict()             # span ckpt AT the cap
+    assert int(state["in_epoch"]) == 1
+
+    resumed = FedSampler(DPC, W, B, seed=17)
+    resumed.load_state_dict(state)
+    assert resumed.resolve_resume(0) == 0
+    assert resumed.pending_pos == CAP        # >= the driver's cap
+    resumed.discard_pending()                # the drivers' rule
+    res_next = []
+    _capped_epoch(resumed, CAP, res_next)
+    assert_streams_equal(ref_next, res_next)
+
+
+def test_resumed_epoch_budget_is_cap_remainder():
+    """Resuming mid-epoch at pos p must drive the restored stream for
+    only cap - p more rounds (cv_train subtracts resumed_pos from
+    epoch_rounds); driving a full cap from the resume point would
+    overrun onto rounds the uninterrupted run abandoned."""
+    CAP = 6
+
+    ref = FedSampler(DPC, W, B, seed=19)
+    ref_rounds = []
+    _capped_epoch(ref, CAP, ref_rounds)
+    ref_next = []
+    _capped_epoch(ref, CAP, ref_next)
+
+    crashed = FedSampler(DPC, W, B, seed=19)
+    rounds = []
+    gen = crashed.epoch()
+    for _ in range(4):                       # crash at pos 4 < CAP
+        rounds.append(next(gen))
+    state = crashed.state_dict()
+
+    resumed = FedSampler(DPC, W, B, seed=19)
+    resumed.load_state_dict(state)
+    assert resumed.resolve_resume(4) == 0
+    pos = resumed.pending_pos
+    assert pos == 4 and pos < CAP            # continue, budget CAP-4
+    tail = []
+    _capped_epoch(resumed, CAP - pos, tail)  # drives the PENDING one
+    assert_streams_equal(ref_rounds[4:], tail)
+    res_next = []
+    _capped_epoch(resumed, CAP, res_next)
+    assert_streams_equal(ref_next, res_next)
+
+
+def test_restored_boundary_state_never_skips_despite_spe_drift():
+    """Real epoch length can drift from the steps_per_epoch estimate
+    (exhaustion-ended epochs), leaving rounds_done % spe != 0 at a
+    genuine epoch-boundary checkpoint (in_epoch=0). A restored rng
+    makes ANY skip wrong — the fresh epoch must start at round 0 of
+    its stream, not skip a mis-estimated head."""
+    ref = FedSampler(DPC, W, B, seed=5)
+    drain(ref, 3)                            # mid... then exhaust
+    gen = ref.epoch()                        # fresh epoch, exhaust it
+    while True:
+        try:
+            next(gen)
+        except StopIteration:
+            break
+    state = ref.state_dict()
+    assert int(state["in_epoch"]) == 0
+    after_ref = drain(ref, 4)
+
+    resumed = FedSampler(DPC, W, B, seed=5)
+    resumed.load_state_dict(state)
+    # the driver's spe estimate says "3 rounds into an epoch" — the
+    # restored state knows better: no skip, fresh epoch
+    assert resumed.resolve_resume(3) == 0
+    assert_streams_equal(after_ref, drain(resumed, 4))
+
+
+def test_state_rejects_mismatched_dataset():
+    s = FedSampler(DPC, W, B, seed=0)
+    drain(s, 2)
+    state = s.state_dict()
+    other = FedSampler(DPC[:-1], W, B, seed=0)
+    with pytest.raises(ValueError, match="does not match"):
+        other.load_state_dict(state)
+
+
+# ---------------------------------------------------------------------------
+# the full non-uniform stack through a real checkpoint file
+
+
+def _throughput_cfg():
+    return Config(mode="uncompressed", grad_size=8, weight_decay=0.0,
+                  num_workers=W, local_momentum=0.0,
+                  virtual_momentum=0.9, error_type="none",
+                  microbatch_size=-1, num_clients=N_CLIENTS,
+                  sampler="throughput", explore_floor=0.1,
+                  seed=11).validate()
+
+
+def _stack(seed_rates=True):
+    """(sampler, scheduler, tracker): the throughput-aware selection
+    stack exactly as attach_round_scheduler wires it, minus the
+    model."""
+    cfg = _throughput_cfg()
+    tracker = ClientThroughputTracker(N_CLIENTS)
+    if seed_rates:
+        # measured, heterogeneous rates so the weighted draw is
+        # genuinely tracker-dependent (round_seconds is per-round
+        # scalar wall clock, so rates vary via per-client rounds)
+        for i in range(N_CLIENTS):
+            tracker.update_round(np.array([i]), np.array([10.0]),
+                                 1.0 + 0.3 * (i % 5))
+    sched = RoundScheduler(cfg, N_CLIENTS, tracker)
+    sampler = FedSampler(DPC, W, B, seed=11, scheduler=sched)
+    return sampler, sched, tracker
+
+
+def _draw_with_tracker(sampler, tracker, n, gen=None):
+    """Draw n rounds, feeding the tracker after each (the live-run
+    coupling that makes later selections depend on earlier rounds)."""
+    out = []
+    while len(out) < n:
+        if gen is None:
+            gen = sampler.epoch()
+        try:
+            r = next(gen)
+        except StopIteration:
+            gen = None
+            continue
+        out.append(r)
+        tracker.update_round(r.client_ids, r.mask.sum(axis=1), 0.5)
+    return out, gen
+
+
+def test_throughput_aware_crash_resume_stream_bit_exact(tmp_path,
+                                                        ckpt_dir):
+    """THE acceptance test: non-uniform mid-epoch crash -> .npz
+    checkpoint -> resume into fresh objects replays the exact same
+    data stream as the uninterrupted run."""
+    from commefficient_tpu.federated.round import (
+        ServerState,
+    )
+    import jax.numpy as jnp
+
+    # uninterrupted reference
+    s_ref, sched_ref, tr_ref = _stack()
+    reference, _ = _draw_with_tracker(s_ref, tr_ref, 12)
+
+    # crashed run: 5 rounds, then checkpoint everything the drivers
+    # checkpoint (tracker thr_*, scheduler sched_*, sampler smp_*)
+    s_a, sched_a, tr_a = _stack()
+    head, _ = _draw_with_tracker(s_a, tr_a, 5)
+    path = str(tmp_path / "ck.npz")
+    server = ServerState(jnp.zeros(8), jnp.zeros(8), jnp.zeros(8),
+                         jnp.asarray(5, jnp.int32))
+    save_checkpoint(path, server, None,
+                    throughput=tr_a.state_dict(),
+                    scheduler=sched_a.state_dict(),
+                    sampler=s_a.state_dict())
+
+    # resume: FRESH stack, everything restored from the file
+    s_b, sched_b, tr_b = _stack(seed_rates=False)
+    ckpt = load_checkpoint(path)
+    assert ckpt.sampler is not None
+    tr_b.load_state_dict(ckpt.throughput)
+    sched_b.load_state_dict(ckpt.scheduler)
+    s_b.load_state_dict(ckpt.sampler)
+    assert s_b.resolve_resume(5) == 0
+    sched_b.begin_epoch(5)
+    tail, _ = _draw_with_tracker(s_b, tr_b, 7)
+
+    assert_streams_equal(reference, head + tail)
+
+
+def test_fedmodel_attach_and_restore_plumbing(tmp_path):
+    """The driver wiring: attach_round_scheduler attaches the sampler
+    to the model, sampler_state() feeds the save sites, and
+    load_state restores into the attached sampler."""
+    import jax.numpy as jnp
+
+    from commefficient_tpu.federated.api import FedModel
+    from commefficient_tpu.scheduler import attach_round_scheduler
+
+    cfg = Config(mode="uncompressed", grad_size=8, weight_decay=0.0,
+                 num_workers=W, local_momentum=0.0,
+                 virtual_momentum=0.9, error_type="none",
+                 microbatch_size=-1, num_clients=N_CLIENTS).validate()
+
+    def loss(params, batch, mask):
+        x, = batch
+        l = ((x @ params["w"]) ** 2).mean()
+        return l, (l,)
+
+    class FakeLoader:
+        pass
+
+    # uninterrupted reference stream, drawn in one continuous pass
+    reference = drain(FedSampler(DPC, W, B, seed=2), 9)
+
+    model = FedModel(None, loss, cfg, params={"w": jnp.zeros(8)},
+                     num_clients=N_CLIENTS)
+    loader = FakeLoader()
+    loader.sampler = FedSampler(DPC, W, B, seed=2)
+    attach_round_scheduler(model, loader)
+    assert model.data_sampler is loader.sampler
+
+    head = drain(loader.sampler, 3)
+    assert_streams_equal(reference[:3], head)
+    path = str(tmp_path / "m.npz")
+    save_checkpoint(path, model.server, model.clients,
+                    fingerprint=model.checkpoint_fingerprint,
+                    sampler=model.sampler_state())
+
+    model2 = FedModel(None, loss, cfg, params={"w": jnp.zeros(8)},
+                      num_clients=N_CLIENTS)
+    loader2 = FakeLoader()
+    loader2.sampler = FedSampler(DPC, W, B, seed=2)
+    attach_round_scheduler(model2, loader2)
+    model2.load_state(load_checkpoint(path))
+    assert loader2.sampler.resume_pending
+
+    assert loader2.sampler.resolve_resume(3) == 0
+    assert_streams_equal(reference[3:], drain(loader2.sampler, 6))
