@@ -475,6 +475,179 @@ def main() -> int:
     return 0
 
 
+def population_main() -> int:
+    """ISSUE 9 population sweep: the O(population) -> O(cohort) claim
+    as numbers. For num_clients in {1e3, 1e5, 1e6} (tiny D so the
+    sharded [population, D] blocks fit anywhere, local_topk so all
+    three state blocks exist) it measures, per population:
+
+      * round_ms             wall-clock of the three-program dispatch
+                             (cohort-gather -> round -> scatter-back)
+      * round_operand_bytes  bytes entering the jitted ROUND program
+                             (server + cohort + batch + lr + key) —
+                             must stay FLAT as the population grows
+      * device_state_bytes   the sharded [padded_population, D] blocks
+                             (the one remaining O(population) term, by
+                             design: it shards across hosts)
+      * checkpoint_bytes     a sparse (crows_*) save after two rounds
+                             — must stay FLAT
+      * host_state_bytes     tracker + accountant host state after the
+                             same rounds — O(clients-ever-seen)
+
+    Runs in-process (CPU-friendly: ~200 MB at the 1e6 point); invoked
+    via BENCH_POPULATION=1 or `python bench.py --population`. The
+    result is journaled as a bench_digest and lands in BENCH_r09.json.
+    """
+    import tempfile
+
+    import numpy as np
+
+    with alarm_guard(INIT_TIMEOUT, "backend init"):
+        import jax
+        import jax.numpy as jnp
+        platform = jax.devices()[0].platform
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.federated import round as fround
+    from commefficient_tpu.federated.accounting import CommAccountant
+    from commefficient_tpu.ops.flat import flatten_params
+    from commefficient_tpu.parallel.mesh import make_client_mesh
+    from commefficient_tpu.telemetry.clients import (
+        ClientThroughputTracker,
+    )
+    from commefficient_tpu.utils.checkpoint import save_checkpoint
+
+    Dp, Wp, Bp, ROUNDS_P = 16, 64, 4, 3
+    n_dev = len(jax.devices())
+    n_mesh = 1
+    for n in range(min(n_dev, Wp), 0, -1):
+        if Wp % n == 0:
+            n_mesh = n
+            break
+    mesh = make_client_mesh(n_mesh)
+    log(f"population sweep on {platform} ({n_mesh}-way clients mesh)")
+
+    def loss_fn(params, batch, mask):
+        x, y = batch
+        pred = x @ params["w"]
+        per_ex = 0.5 * (pred - y) ** 2
+        loss = (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, (loss,)
+
+    params = {"w": jnp.zeros(Dp, jnp.float32)}
+    vec, unravel = flatten_params(params)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(Wp, Bp, Dp).astype(np.float32))
+    y = jnp.asarray(rng.randn(Wp, Bp).astype(np.float32))
+    mask = jnp.ones((Wp, Bp), jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    def tree_bytes(tree):
+        import jax as _j
+        return int(sum(int(getattr(l, "nbytes", 0))
+                       for l in _j.tree.leaves(tree)))
+
+    def state_dict_bytes(sd):
+        return int(sum(np.asarray(v).nbytes for v in sd.values()))
+
+    sweep = {}
+    for pop in (1_000, 100_000, 1_000_000):
+        cfg = Config(
+            mode="local_topk", error_type="local", local_momentum=0.9,
+            do_topk_down=True, k=8, down_k=16, grad_size=Dp,
+            weight_decay=0.0, num_workers=Wp, microbatch_size=-1,
+            num_clients=pop, seed=0).validate()
+        with alarm_guard(STAGE_TIMEOUT, f"pop={pop} build"):
+            tr = fround.make_train_fn(loss_fn, unravel, cfg, mesh)
+            server = fround.init_server_state(cfg, vec, mesh=mesh)
+            clients = fround.init_client_state(cfg, pop, vec,
+                                               mesh=mesh)
+        device_state_bytes = tree_bytes(clients)
+        ids_rounds = [rng.choice(pop, Wp, replace=False)
+                      .astype(np.int32) for _ in range(ROUNDS_P)]
+        tracker = ClientThroughputTracker(pop)
+        acct = CommAccountant(cfg, pop)
+        prev = None
+
+        def one_round(server, clients, ids):
+            b = fround.RoundBatch(jnp.asarray(ids), (x, y), mask)
+            return tr(server, clients, b, 0.1, key)
+
+        with alarm_guard(STAGE_TIMEOUT, f"pop={pop} rounds"):
+            t_rounds = []
+            for n, ids in enumerate(ids_rounds):
+                t0 = time.perf_counter()
+                server, clients, m = one_round(server, clients, ids)
+                # block on a cohort-sized output (the 4-byte-class
+                # sync every bench uses)
+                float(np.asarray(m.losses).sum())
+                t_rounds.append(time.perf_counter() - t0)
+                tracker.update_round(ids, np.full(Wp, float(Bp)),
+                                     round_seconds=t_rounds[-1])
+                d, u = acct.record_round(ids, prev)
+                prev = np.zeros(acct.n_words, np.uint32)
+            round_ms = float(np.median(t_rounds[1:])) * 1e3
+
+        # the round program's operand bytes: what actually crosses
+        # into the jitted round — cohort rows, never the population
+        cohort = tr.gather(clients, jnp.asarray(ids_rounds[-1]))
+        batch = fround.RoundBatch(jnp.asarray(ids_rounds[-1]), (x, y),
+                                  mask)
+        round_operand_bytes = (tree_bytes(server) + tree_bytes(cohort)
+                               + tree_bytes(batch) + 4
+                               + tree_bytes(key))
+
+        # sparse checkpoint: touched rows only (the drivers'
+        # client_rows payload, assembled here without a FedModel)
+        touched = np.unique(np.concatenate(ids_rounds)).astype(np.int64)
+        gidx = jnp.asarray(touched.astype(np.int32))
+        payload = {
+            "ids": touched,
+            "errors": np.asarray(clients.errors[gidx]),
+            "velocities": np.asarray(clients.velocities[gidx]),
+            "weights": np.asarray(clients.weights[gidx]),
+            "base_weights": np.asarray(vec, np.float32),
+        }
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "ck.npz")
+            save_checkpoint(p, server, clients=None,
+                            client_rows=payload,
+                            accountant=acct,
+                            throughput=tracker.state_dict())
+            checkpoint_bytes = os.path.getsize(p)
+
+        host_state_bytes = (state_dict_bytes(tracker.state_dict())
+                            + state_dict_bytes(acct.state_dict()))
+        sweep[str(pop)] = {
+            "round_ms": round(round_ms, 3),
+            "round_operand_bytes": round_operand_bytes,
+            "device_state_bytes": device_state_bytes,
+            "checkpoint_bytes": checkpoint_bytes,
+            "host_state_bytes": host_state_bytes,
+        }
+        log(f"pop={pop}: {sweep[str(pop)]}")
+        del server, clients, tr
+
+    flat = [sweep[k]["round_operand_bytes"] for k in sweep]
+    ck = [sweep[k]["checkpoint_bytes"] for k in sweep]
+    out = {
+        "metric": "client_state_population_sweep",
+        "value": sweep["1000000"]["round_ms"],
+        "unit": "ms/round",
+        "vs_baseline": None,
+        "platform": platform,
+        "geometry": {"D": Dp, "num_workers": Wp, "local_batch": Bp,
+                     "mode": "local_topk"},
+        "populations": sweep,
+        # the acceptance claims, as booleans the artifact itself checks
+        "round_operands_flat": len(set(flat)) == 1,
+        "checkpoint_flat": max(ck) <= min(ck) + 65536,
+    }
+    journal_digest(out, "bench_digest")
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _run_child(extra_env, timeout_s, script=None):
     """Run the measurement in a child process; returns the parsed JSON
     line or None. A hard kill-on-timeout is the only watchdog that
@@ -693,6 +866,11 @@ def orchestrate() -> int:
 
 
 if __name__ == "__main__":
+    if (os.environ.get("BENCH_POPULATION") == "1"
+            or "--population" in sys.argv):
+        # ISSUE 9 population sweep: in-process (tiny D, CPU-friendly);
+        # the primary flagship bench below is untouched
+        raise SystemExit(worker_entry(population_main))
     if os.environ.get("BENCH_IS_WORKER") == "1":
         raise SystemExit(worker_entry(main))
     raise SystemExit(orchestrate())

@@ -23,14 +23,18 @@ gating — for the contracts prose and AST can't check:
          regression class (~125 ms/round on TPU, PERF.md §1), caught
          here AFTER all dispatch gating, so a config routing around
          `approx_max_k`/the fused kernels cannot hide.
-  AU004  population scaling: any buffer whose shape carries the
-         num_clients sentinel that is NOT a declared client-state
-         input or carried output. The inputs/outputs themselves are
-         emitted as a named INVENTORY — the dense per-client-state
-         map the ROADMAP's million-client O(cohort) refactor starts
-         from — while a population-shaped INTERMEDIATE (or baked-in
-         constant) is an error: the jitted round must touch client
-         state only through cohort-sized gather/scatter.
+  AU004  population scaling. Since ISSUE 9 the rule is STRICT for
+         round programs: ANY population-shaped value — input, output,
+         intermediate, or baked-in constant — is an error, because
+         the cohort-gather/scatter-back state-motion programs are the
+         only programs allowed to touch the [population, D] blocks
+         and the jitted round operates purely on [num_workers, D]
+         CohortState rows. The gather/scatter programs themselves
+         (and configs listed in `population_inventory_configs`,
+         for workloads that legitimately keep dense in-round state)
+         keep the pre-ISSUE-9 INVENTORY semantics: population-shaped
+         inputs/outputs are emitted as the named client-state map,
+         intermediates/constants still error.
   AU005  dead-but-undonated round inputs: federated/round declares
          which dispatch operands the caller never reads again
          (ROUND_DEAD_ARGNUMS / SPAN_DEAD_ARGNUMS); each must be
@@ -206,16 +210,24 @@ def forbidden_primitive_findings(program: str, closed
 
 
 def population_scan(program: str, closed, population: int,
-                    in_names: Sequence[str], out_names: Sequence[str]
+                    in_names: Sequence[str], out_names: Sequence[str],
+                    strict: bool = False
                     ) -> Tuple[dict, List[AuditFinding]]:
     """AU004 + the named client-state inventory.
 
-    Inputs/outputs whose shape carries the population sentinel are
-    INVENTORY (the dense per-client-state rows the million-client
-    refactor must shard); any OTHER population-shaped value — an
-    intermediate, or a constant baked into the program — is a finding:
-    the round program may only touch population state through
-    cohort-sized gather/scatter."""
+    strict=False (the state-motion programs; opted-out configs):
+    inputs/outputs whose shape carries the population sentinel are
+    INVENTORY (the dense per-client-state rows the gather/scatter
+    programs legitimately move); any OTHER population-shaped value —
+    an intermediate, or a constant baked into the program — is a
+    finding.
+
+    strict=True (round programs since ISSUE 9): population-shaped
+    inputs/outputs are ERRORS too — the jitted round's operand
+    surface is CohortState rows, and a population block reappearing
+    there is the exact regression the O(active) refactor exists to
+    prevent. The inventory block is still emitted (it must be empty —
+    the refactor's mechanical definition of done)."""
     jaxpr = closed.jaxpr
     findings: List[AuditFinding] = []
 
@@ -229,12 +241,27 @@ def population_scan(program: str, closed, population: int,
                 "name": name, "shape": list(_shape_of(v)),
                 "dtype": str(_dtype_of(v)),
                 "bytes": aval_bytes(v.aval)})
+            if strict:
+                findings.append(AuditFinding(
+                    program, "AU004",
+                    f"population-shaped INPUT `{name}` "
+                    f"{list(_shape_of(v))}: round programs take only "
+                    "cohort-sized operands — population state moves "
+                    "through the gather/scatter state-motion programs "
+                    "(ISSUE 9 O(active) contract)"))
     for v, name in zip(jaxpr.outvars, out_names):
         if pop_shaped(v):
             inventory["outputs"].append({
                 "name": name, "shape": list(_shape_of(v)),
                 "dtype": str(_dtype_of(v)),
                 "bytes": aval_bytes(getattr(v, "aval", None))})
+            if strict:
+                findings.append(AuditFinding(
+                    program, "AU004",
+                    f"population-shaped OUTPUT `{name}` "
+                    f"{list(_shape_of(v))}: round programs return only "
+                    "cohort-sized results — scatter-back owns the "
+                    "population write (ISSUE 9 O(active) contract)"))
 
     for cv, const in zip(jaxpr.constvars, closed.consts):
         if pop_shaped(cv):
@@ -300,17 +327,25 @@ def population_scan(program: str, closed, population: int,
 
 def donation_findings(config_name: str, handle) -> List[AuditFinding]:
     """AU005: the dispatch entry points' dead operands vs what their
-    jits actually donate (federated/round's registry attributes)."""
+    jits actually donate (federated/round's registry attributes).
+    Three entries since ISSUE 9: the cohort round program (its
+    gathered CohortState is dead), the scatter-back state-motion
+    program (the full ClientState is dead — at population scale THE
+    donation that matters), and the scanned span."""
     from commefficient_tpu.federated.round import (
-        ROUND_DEAD_ARGNUMS, SPAN_DEAD_ARGNUMS,
+        ROUND_DEAD_ARGNUMS, SCATTER_DEAD_ARGNUMS, SPAN_DEAD_ARGNUMS,
     )
-    argname = {0: "ServerState", 1: "ClientState"}
     out: List[AuditFinding] = []
-    for entry, dead, donated in (
+    for entry, dead, donated, argname in (
             ("per-round", ROUND_DEAD_ARGNUMS,
-             getattr(handle, "round_donate_argnums", ())),
+             getattr(handle, "round_donate_argnums", ()),
+             {0: "ServerState", 1: "CohortState"}),
+            ("scatter-back", SCATTER_DEAD_ARGNUMS,
+             getattr(handle, "scatter_donate_argnums", ()),
+             {0: "ClientState"}),
             ("scanned-span", SPAN_DEAD_ARGNUMS,
-             getattr(handle, "span_donate_argnums", ()))):
+             getattr(handle, "span_donate_argnums", ()),
+             {0: "ServerState", 1: "ClientState"})):
         for argnum in dead:
             if argnum not in tuple(donated):
                 out.append(AuditFinding(
@@ -411,18 +446,50 @@ def _leaf_names(prefix: str, tree) -> List[str]:
 
 def trace_variant(handle, server, clients, batch, lr, key):
     """(ClosedJaxpr, invar names, outvar names) of the single-round
-    program this handle dispatches for `batch`'s treedef — the same
-    body both the per-round jit and the scanned span compile."""
+    program this handle dispatches for `batch`'s treedef — the COHORT
+    round body (round.make_train_fn round_step): the gathered
+    CohortState avals come from jax.eval_shape over the gather body,
+    so the traced operand surface is exactly what the round jit
+    compiles and AU004-strict checks."""
     import jax
+    cohort = jax.eval_shape(handle.gather_fn, clients,
+                            batch.client_ids)
     closed, out_shape = jax.make_jaxpr(
         handle.round_step, return_shape=True)(
-        server, clients, batch, lr, key)
+        server, cohort, batch, lr, key)
     in_names = (_leaf_names("server", server)
-                + _leaf_names("clients", clients)
+                + _leaf_names("cohort", cohort)
                 + _leaf_names("batch", batch)
                 + _leaf_names("lr", lr) + _leaf_names("key", key))
     out_names = _leaf_names("out", out_shape)
     return closed, in_names, out_names
+
+
+def trace_state_motion(handle, clients, batch):
+    """{"gather": (...), "scatter": (...)} — the two state-motion
+    programs bracketing every round dispatch (round.
+    STATE_MOTION_PROGRAMS), traced like trace_variant. These are the
+    only programs ALLOWED to carry population-shaped inputs/outputs;
+    their AU004 scan runs in inventory mode and their inventory IS
+    the named client-state map the round programs no longer have."""
+    import jax
+    ids = batch.client_ids
+    cohort = jax.eval_shape(handle.gather_fn, clients, ids)
+    out = {}
+    closed, g_shape = jax.make_jaxpr(
+        handle.gather_fn, return_shape=True)(clients, ids)
+    out["gather"] = (closed,
+                     _leaf_names("clients", clients)
+                     + _leaf_names("ids", ids),
+                     _leaf_names("cohort", g_shape))
+    closed, s_shape = jax.make_jaxpr(
+        handle.scatter_fn, return_shape=True)(clients, ids, cohort)
+    out["scatter"] = (closed,
+                      _leaf_names("clients", clients)
+                      + _leaf_names("ids", ids)
+                      + _leaf_names("cohort", cohort),
+                      _leaf_names("clients", s_shape))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -561,16 +628,26 @@ def exit_code(violations: Sequence, drift: Sequence,
 # the full audit
 
 
-def run_audit(backends: Sequence[str] = ("xla", "pallas")
+def run_audit(backends: Sequence[str] = ("xla", "pallas"),
+              inventory_configs: Sequence[str] = ()
               ) -> Tuple[dict, List[AuditFinding]]:
-    """Trace every audit config x program variant; return (report,
-    findings). Findings carry AU001-AU005; AU006 (cost drift) is the
-    caller's baseline diff — the report's `costs` block feeds it."""
+    """Trace every audit config x (round program variant + the two
+    state-motion programs); return (report, findings). Findings carry
+    AU001-AU005; AU006 (cost drift) is the caller's baseline diff —
+    the report's `costs` block feeds it.
+
+    Round programs are AU004-STRICT (population-shaped inputs/outputs
+    error) unless the config name is in `inventory_configs` — the
+    opt-out for workloads that legitimately keep dense in-round state
+    ([tool.graftaudit] population_inventory_configs). The gather/
+    scatter state-motion programs always run in inventory mode: their
+    inventory is the named client-state map."""
     from commefficient_tpu.federated.round import PROGRAM_VARIANTS
 
     programs: Dict[str, dict] = {}
     findings: List[AuditFinding] = []
     for cfg_name, cfg in audit_configs(backends):
+        strict = cfg_name not in set(inventory_configs)
         handle, server, clients, variants, lr, key = build_workload(cfg)
         findings.extend(donation_findings(cfg_name, handle))
         for variant in PROGRAM_VARIANTS:
@@ -580,7 +657,22 @@ def run_audit(backends: Sequence[str] = ("xla", "pallas")
             findings.extend(
                 forbidden_primitive_findings(prog, closed))
             inventory, pop_findings = population_scan(
-                prog, closed, AUDIT_POPULATION, in_names, out_names)
+                prog, closed, AUDIT_POPULATION, in_names, out_names,
+                strict=strict)
+            findings.extend(pop_findings)
+            programs[prog] = {
+                "cost": jaxpr_cost(closed).as_dict(),
+                "population_inventory": inventory,
+            }
+        for motion, (closed, in_names, out_names) in \
+                trace_state_motion(handle, clients,
+                                   variants["mask_free"]).items():
+            prog = f"{cfg_name}/{motion}"
+            findings.extend(
+                forbidden_primitive_findings(prog, closed))
+            inventory, pop_findings = population_scan(
+                prog, closed, AUDIT_POPULATION, in_names, out_names,
+                strict=False)
             findings.extend(pop_findings)
             programs[prog] = {
                 "cost": jaxpr_cost(closed).as_dict(),
@@ -668,6 +760,15 @@ def main(argv: Optional[list] = None) -> int:
                                           ["xla", "pallas"])),
                     help="kernel backends to trace the sketch "
                          "programs on")
+    ap.add_argument("--inventory-configs", nargs="*",
+                    default=list(conf.get(
+                        "population_inventory_configs", [])),
+                    help="audit-config names whose ROUND programs keep "
+                         "the pre-ISSUE-9 AU004 inventory semantics "
+                         "(population-shaped inputs/outputs reported, "
+                         "not errored) — the opt-out for workloads "
+                         "that legitimately keep dense in-round "
+                         "client state")
     ap.add_argument("--journal", default="",
                     help="append the cost report to this JSONL run "
                          "journal as an `audit_digest` event")
@@ -690,7 +791,8 @@ def main(argv: Optional[list] = None) -> int:
                   file=sys.stderr)
             return 3
 
-    report, findings = run_audit(args.backends)
+    report, findings = run_audit(
+        args.backends, inventory_configs=args.inventory_configs)
 
     if args.write_baseline:
         counts: Dict[Tuple[str, str], int] = {}

@@ -110,9 +110,12 @@ MESH_POPULATION = 184
 # per-link report scales linearly with it and the baseline prices it)
 SPAN_LEN = 2
 
-# the three single-round treedefs plus the scanned span — the full
-# dispatch surface of federated/round.make_train_fn
-MESH_PROGRAMS = ("mask_free", "dropout", "dropout_stragglers", "span")
+# the three single-round treedefs, the two state-motion programs
+# (cohort gather / scatter-back — since ISSUE 9 the only programs
+# whose operands may carry the population dimension), and the scanned
+# span — the full dispatch surface of federated/round.make_train_fn
+MESH_PROGRAMS = ("mask_free", "dropout", "dropout_stragglers",
+                 "gather", "scatter", "span")
 
 # jaxpr equations that re-lay-out an existing value (AU011's
 # reshard-class set)
@@ -260,6 +263,11 @@ def build_mesh_workload(cfg, mesh):
         "dropout": batch._replace(survivors=ones, work=None),
         "dropout_stragglers": batch._replace(survivors=ones, work=half),
     }
+    # the CONCRETE gathered cohort: executed through the production
+    # jitted gather (explicit out_shardings), so the round variants'
+    # cohort operands carry exactly the placement the dispatch path
+    # produces — AU009/AU007 check the real thing
+    cohort = handle.gather(clients, batch.client_ids)
     span = RoundBatch(
         mh.globalize(mesh, P(), np.tile(
             np.arange(g["W"], dtype=np.int32), (SPAN_LEN, 1))),
@@ -274,27 +282,48 @@ def build_mesh_workload(cfg, mesh):
     lr = mh.globalize(mesh, P(), np.float32(0.1))
     key = mh.globalize(mesh, P(),
                        np.asarray(jax.random.PRNGKey(0)))
-    return handle, server, clients, variants, span, lr, lrs, key
+    return (handle, server, clients, cohort, variants, span, lr, lrs,
+            key)
 
 
-def trace_mesh_program(handle, server, clients, variants, span,
-                       lr, lrs, key, program: str):
+def trace_mesh_program(handle, server, clients, cohort, variants,
+                       span, lr, lrs, key, program: str):
     """(ClosedJaxpr, input leaves with names) for one MESH_PROGRAMS
     entry. Input leaves are the CONCRETE mesh-placed operands (AU007 /
-    AU009 read their .sharding); the jaxpr is what the per-round jit /
-    the scanned span compiles."""
+    AU009 read their .sharding); the jaxpr is what the per-round jit,
+    the state-motion jits, or the scanned span compiles. The round
+    variants take the gathered CohortState (ISSUE 9) — their operand
+    surface is population-free; the gather/scatter programs are the
+    ones carrying the sharded [population, D] blocks."""
     import jax
 
     if program == "span":
         args = (server, clients, span, lrs, key)
         closed = jax.make_jaxpr(handle.train_rounds)(*args)
+        names = (_leaf_names("server", server)
+                 + _leaf_names("clients", clients)
+                 + _leaf_names("batch", span)
+                 + _leaf_names("lr", lrs) + _leaf_names("key", key))
+    elif program == "gather":
+        ids = variants["mask_free"].client_ids
+        args = (clients, ids)
+        closed = jax.make_jaxpr(handle.gather_fn)(*args)
+        names = (_leaf_names("clients", clients)
+                 + _leaf_names("ids", ids))
+    elif program == "scatter":
+        ids = variants["mask_free"].client_ids
+        args = (clients, ids, cohort)
+        closed = jax.make_jaxpr(handle.scatter_fn)(*args)
+        names = (_leaf_names("clients", clients)
+                 + _leaf_names("ids", ids)
+                 + _leaf_names("cohort", cohort))
     else:
-        args = (server, clients, variants[program], lr, key)
+        args = (server, cohort, variants[program], lr, key)
         closed = jax.make_jaxpr(handle.round_step)(*args)
-    names = (_leaf_names("server", args[0])
-             + _leaf_names("clients", args[1])
-             + _leaf_names("batch", args[2])
-             + _leaf_names("lr", args[3]) + _leaf_names("key", args[4]))
+        names = (_leaf_names("server", server)
+                 + _leaf_names("cohort", cohort)
+                 + _leaf_names("batch", variants[program])
+                 + _leaf_names("lr", lr) + _leaf_names("key", key))
     leaves = jax.tree_util.tree_leaves(args)
     return closed, list(zip(names, leaves))
 

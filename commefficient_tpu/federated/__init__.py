@@ -1,6 +1,7 @@
 from commefficient_tpu.federated.round import (  # noqa: F401
-    RoundBatch, ServerState, ClientState, RoundMetrics,
-    init_server_state, init_client_state, make_round_fns,
+    RoundBatch, ServerState, ClientState, CohortState, RoundMetrics,
+    client_state_specs, init_server_state, init_client_state,
+    make_round_fns,
 )
 from commefficient_tpu.federated.server import (  # noqa: F401
     ServerUpdate, get_server_update, args2sketch,

@@ -175,7 +175,37 @@ class CommAccountant:
                              * (1.0 - cfg.client_dropout))
             maxlen = int(DEQUE_MAXLEN_MULT / participation)
             self.changes: deque = deque([], maxlen=maxlen)
-            self.stale = np.zeros(num_clients, np.int64)
+            # SPARSE staleness (ISSUE 9): a dense [num_clients] int64
+            # vector made accountant state O(population). Staleness of
+            # client c is `rounds_seen - last reset`, where the reset
+            # round is stored only for clients that have ever
+            # participated (never-seen clients default to reset 0 =
+            # stale since the beginning, exactly the dense vector's
+            # semantics) — O(clients-ever-seen) state and checkpoint.
+            self.rounds_seen = 0
+            self._last_reset: dict = {}
+
+    def _check_ids(self, participating: np.ndarray) -> None:
+        """The dense stale vector this storage replaced bounds-checked
+        ids implicitly via fancy indexing; the sparse map must do it
+        explicitly or a caller bug books phantom clients that ride
+        into every checkpoint (same guard as the tracker's
+        _rows_for)."""
+        if participating.size and (
+                int(participating.min()) < 0
+                or int(participating.max()) >= self.num_clients):
+            raise ValueError(
+                f"client id out of range for a {self.num_clients}-"
+                f"client population: {participating}")
+
+    def staleness(self, client_ids) -> np.ndarray:
+        """Rounds since each client's last COMPLETED round (unclipped;
+        the download math clips to the change-window length). Exposed
+        because the dense `stale` vector is gone — staleness is now
+        derived from the sparse reset map."""
+        ids = np.asarray(client_ids, np.int64).reshape(-1)
+        return np.array([self.rounds_seen - self._last_reset.get(int(c), 0)
+                         for c in ids], np.int64)
 
     def record_round(self, participating: np.ndarray,
                      prev_changed_words: Optional[np.ndarray],
@@ -188,36 +218,44 @@ class CommAccountant:
         `survivors`: optional [W] {0,1} mask aligned with
         `participating` (client dropout). A dropped client completed
         neither its download nor its upload, so it is charged NOTHING
-        and its staleness counter keeps growing — it will pay the
-        accumulated download the next round it actually finishes.
+        and its staleness keeps growing — it will pay the accumulated
+        download the next round it actually finishes.
 
-        Returns (download_bytes, upload_bytes), each [num_clients].
+        Returns (download_bytes, upload_bytes), each [W] COHORT-indexed
+        — aligned slot-for-slot with `participating`, dropped slots
+        charged 0.0. (Before ISSUE 9 these were [num_clients] vectors:
+        two population-length allocations per round, the exact
+        O(population) host cost the refactor removes. Every consumer
+        only ever indexed participants or summed.)
         """
-        download = np.zeros(self.num_clients)
-        participating = np.asarray(participating)
-        if survivors is not None:
-            participating = participating[np.asarray(survivors) > 0]
+        participating = np.asarray(participating).reshape(-1)
+        self._check_ids(participating)
+        W = participating.shape[0]
+        alive = (np.ones(W, bool) if survivors is None
+                 else np.asarray(survivors).reshape(-1) > 0)
+        completed = participating[alive]
+        download = np.zeros(W)
 
         if self.cheap:
             if prev_changed_words is not None:
                 self.updated_since_init |= np.asarray(prev_changed_words)
-            download[participating] = 4.0 * _popcount(self.updated_since_init)
+            download[alive] = 4.0 * _popcount(self.updated_since_init)
         else:
             if prev_changed_words is not None:
                 self.changes.append(np.asarray(prev_changed_words))
-            if len(self.changes) and len(participating):
-                stale = np.clip(self.stale[participating], 0,
+            if len(self.changes) and len(completed):
+                stale = np.clip(self.staleness(completed), 0,
                                 len(self.changes))
                 # staleness values share one OR-reduction prefix walk
                 counts = _prefix_or_popcounts(
                     self.changes, np.unique(stale), self.n_words)
-                download[participating] = [
-                    4.0 * counts[int(s)] for s in stale]
-            self.stale[participating] = 0
-            self.stale += 1
+                download[alive] = [4.0 * counts[int(s)] for s in stale]
+            for c in completed:
+                self._last_reset[int(c)] = self.rounds_seen
+            self.rounds_seen += 1
 
-        upload = np.zeros(self.num_clients)
-        upload[participating] = self.upload_bytes
+        upload = np.zeros(W)
+        upload[alive] = self.upload_bytes
 
         if self.cfg.mode == "local_topk" and prev_changed_words is not None:
             # realized support of the previous round's aggregate
@@ -233,22 +271,25 @@ class CommAccountant:
                       survivors: Optional[np.ndarray] = None) -> None:
         """Advance the accountant's state for a round whose byte totals
         the caller doesn't want (FedModel.run_rounds(account=False)):
-        the change deque and staleness counters move exactly as in
+        the change deque and staleness bookkeeping move exactly as in
         record_round (dropped clients' staleness included), only the
         popcount work is skipped. Without this, the first accounted
         round after an unaccounted span would misattribute download
         bytes."""
-        participating = np.asarray(participating)
+        participating = np.asarray(participating).reshape(-1)
+        self._check_ids(participating)
         if survivors is not None:
-            participating = participating[np.asarray(survivors) > 0]
+            participating = participating[
+                np.asarray(survivors).reshape(-1) > 0]
         if self.cheap:
             if prev_changed_words is not None:
                 self.updated_since_init |= np.asarray(prev_changed_words)
         else:
             if prev_changed_words is not None:
                 self.changes.append(np.asarray(prev_changed_words))
-            self.stale[participating] = 0
-            self.stale += 1
+            for c in participating:
+                self._last_reset[int(c)] = self.rounds_seen
+            self.rounds_seen += 1
 
     # -- checkpoint round-trip (utils.checkpoint serializes this so
     #    resumed runs keep cumulative comm totals correct) -------------
@@ -257,7 +298,14 @@ class CommAccountant:
         if self.cheap:
             state["updated_since_init"] = self.updated_since_init.copy()
         else:
-            state["stale"] = self.stale.copy()
+            # sparse staleness (ISSUE 9): O(clients-ever-seen) arrays,
+            # not the dense [num_clients] vector — checkpoints stay
+            # O(cohort) at million-client populations
+            ids = np.array(sorted(self._last_reset), np.int64)
+            state["stale_rounds"] = np.int64(self.rounds_seen)
+            state["stale_ids"] = ids
+            state["stale_at"] = np.array(
+                [self._last_reset[int(c)] for c in ids], np.int64)
             state["changes"] = (np.stack(list(self.changes))
                                 if len(self.changes)
                                 else np.zeros((0, self.n_words), np.uint32))
@@ -268,7 +316,24 @@ class CommAccountant:
             self.updated_since_init = np.asarray(
                 state["updated_since_init"], np.uint32)
         else:
-            self.stale = np.asarray(state["stale"], np.int64)
+            if "stale_ids" in state:
+                self.rounds_seen = int(np.asarray(state["stale_rounds"]))
+                ids = np.asarray(state["stale_ids"], np.int64)
+                at = np.asarray(state["stale_at"], np.int64)
+                self._last_reset = {int(c): int(a)
+                                    for c, a in zip(ids, at)}
+            else:
+                # legacy dense vector: recover an equivalent sparse
+                # map. Absolute round counts beyond the change-window
+                # clip never matter, so anchoring rounds_seen at the
+                # vector's max staleness preserves every observable
+                # charge (never-seen clients sat AT the max).
+                stale = np.asarray(state["stale"], np.int64)
+                self.rounds_seen = int(stale.max()) if stale.size else 0
+                self._last_reset = {
+                    int(c): int(self.rounds_seen - s)
+                    for c, s in enumerate(stale)
+                    if int(s) != self.rounds_seen}
             rows = np.asarray(state["changes"], np.uint32)
             if self.changes.maxlen is not None and \
                     len(rows) > self.changes.maxlen:

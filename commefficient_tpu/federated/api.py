@@ -139,6 +139,18 @@ class FedModel:
         self.server = fround.init_server_state(cfg, vec, mesh=self.mesh)
         self.clients = fround.init_client_state(
             cfg, self.num_clients, vec, mesh=self.mesh)
+        # O(cohort) checkpointing (ISSUE 9): client-state rows are zero
+        # (or the init-weights tile, topk_down) until a client first
+        # participates, so checkpoints persist only the rows of
+        # clients-ever-sampled — this host-side id set tracks them.
+        # The init-weights host copy reconstructs untouched topk_down
+        # rows at resume. A resume from a LEGACY dense checkpoint loses
+        # the touched set, so saves fall back to dense from there on
+        # (_sparse_rows_ok).
+        self._touched: set = set()
+        self._sparse_rows_ok = True
+        self._init_weights_host = (np.asarray(vec, np.float32)
+                                   if cfg.do_topk_down else None)
 
         self.accountant = CommAccountant(
             cfg, self.num_clients,
@@ -281,10 +293,13 @@ class FedModel:
         one. `batch` is a (client_ids, data, mask) triple exactly as
         `model(batch)` takes it; only its shapes/dtypes matter (the
         trace is abstract — nothing executes, no state moves). The
-        traced body is `round.make_train_fn`'s round_step, i.e. the
-        same program the per-round jit AND each scanned-span step
-        compile, so what the auditor walks is what run_rounds
-        dispatches.
+        traced body is `round.make_train_fn`'s COHORT round_step — the
+        program the per-round jit compiles, operating on the gathered
+        [num_workers, D] CohortState rows (jax.eval_shape over the
+        gather body supplies their avals; nothing executes) — so what
+        the auditor walks is what `model(batch)` dispatches, and a
+        population-shaped operand showing up in it is exactly the
+        AU004 regression the audit hard-errors on.
 
         include_span=True adds a "span" entry: the scanned
         `train_rounds` program over `span_len` stacked copies of the
@@ -305,10 +320,12 @@ class FedModel:
         # this model never runs
         lr = (jnp.asarray(0.1 * self.lr_scale_vec)
               if self.lr_scale_vec is not None else jnp.float32(0.1))
+        cohort = jax.eval_shape(self._train_round.gather_fn,
+                                self.clients, rb.client_ids)
         out = {}
         for variant, vb in audit_batch_variants(rb).items():
             out[variant] = jax.make_jaxpr(self._train_round.round_step)(
-                self.server, self.clients, vb, lr, self._key)
+                self.server, cohort, vb, lr, self._key)
         if include_span:
             span = stack_batch_for_span(rb, span_len)
             # stacking handles both lr avals: [span_len] for the
@@ -318,6 +335,49 @@ class FedModel:
                 self._train_round.train_rounds)(
                 self.server, self.clients, span, lrs, self._key)
         return out
+
+    def client_rows_payload(self) -> Optional[dict]:
+        """The O(cohort) client-state checkpoint payload
+        (utils/checkpoint `crows_*` keys): the touched-row id set, the
+        gathered rows of every tracked state block for exactly those
+        ids, and (topk_down) the init-weights base vector untouched
+        rows are reconstructed from. None when this model cannot
+        guarantee row sparseness — stateless configs (nothing to
+        save), or a resume from a legacy dense checkpoint (unknown
+        touched set) — in which case callers fall back to the dense
+        `clients` save path.
+
+        The device gather pads the id list to a 256 multiple so its
+        program recompiles O(log) times over a run, not per save; the
+        host transfer is explicit (mh.gather_host), so span-boundary
+        saves stay transfer-guard-clean."""
+        tracked = [l.ndim == 2 for l in self.clients]
+        if not any(tracked):
+            return None
+        if not self._sparse_rows_ok:
+            return None
+        ids = (np.sort(np.fromiter(self._touched, np.int64))
+               if self._touched else np.zeros((0,), np.int64))
+        payload = {"ids": ids}
+        if self._init_weights_host is not None:
+            payload["base_weights"] = self._init_weights_host
+        empty = np.zeros((0,), np.float32)
+        if len(ids) == 0:
+            for name in ("errors", "velocities", "weights"):
+                payload[name] = empty
+            return payload
+        padded = np.pad(ids, (0, (-len(ids)) % 256), mode="edge")
+        gidx = mh.globalize(self.mesh, self._P(),
+                            padded.astype(np.int32))
+        for name, used in zip(("errors", "velocities", "weights"),
+                              tracked):
+            if not used:
+                payload[name] = empty
+                continue
+            field = getattr(self.clients, name)
+            payload[name] = np.asarray(
+                mh.gather_host(field[gidx]))[:len(ids)]
+        return payload
 
     @property
     def checkpoint_fingerprint(self) -> dict:
@@ -470,13 +530,53 @@ class FedModel:
             mh.globalize(self.mesh, P(), s.Vvelocity),
             mh.globalize(self.mesh, P(), s.Verror),
             mh.globalize(self.mesh, P(), s.round_idx))
-        if ckpt.clients is not None:
-            def place(field):
-                arr = np.asarray(field)
-                spec = P("clients", None) if arr.ndim == 2 else P()
-                return mh.globalize(self.mesh, spec, arr)
-            self.clients = fround.ClientState(
-                *[place(f) for f in ckpt.clients])
+        if ckpt.client_rows is not None:
+            # O(cohort) checkpoint (crows_* keys): rebuild the sharded
+            # population blocks from init — zeros, or the saved
+            # init-weights tile for topk_down — then scatter the saved
+            # touched rows in. Bit-exact: untouched rows never left
+            # their init values (dropped clients' rows are written
+            # back bit-untouched), so init + touched rows IS the full
+            # state.
+            rows = ckpt.client_rows
+            if rows.get("base_weights") is not None:
+                self._init_weights_host = np.asarray(
+                    rows["base_weights"], np.float32)
+            base = (self._init_weights_host
+                    if self._init_weights_host is not None
+                    else np.asarray(ckpt.server.ps_weights, np.float32))
+            self.clients = fround.init_client_state(
+                self.cfg, self.num_clients, jnp.asarray(base),
+                mesh=self.mesh)
+            ids = np.asarray(rows["ids"], np.int64)
+            self._touched = set(int(i) for i in ids)
+            self._sparse_rows_ok = True
+            if len(ids):
+                gidx = mh.globalize(self.mesh, P(),
+                                    ids.astype(np.int32))
+                new = self.clients
+                for name in ("errors", "velocities", "weights"):
+                    data = np.asarray(rows.get(name, ()))
+                    field = getattr(new, name)
+                    if data.ndim != 2 or field.ndim != 2:
+                        continue
+                    placed = mh.globalize(self.mesh, P(),
+                                          data.astype(np.float32))
+                    new = new._replace(
+                        **{name: field.at[gidx].set(placed)})
+                self.clients = new
+        elif ckpt.clients is not None:
+            # legacy dense client blocks: place them whole. The
+            # touched-row set is unrecoverable from a dense save, so
+            # this model's own checkpoints fall back to the dense
+            # format from here on (client_rows_payload -> None) rather
+            # than silently dropping pre-resume rows from sparse saves.
+            specs = fround.client_state_specs(ckpt.clients)
+            self.clients = fround.ClientState(*[
+                mh.globalize(self.mesh, spec, np.asarray(field))
+                for field, spec in zip(ckpt.clients, specs)])
+            if any(np.asarray(f).ndim == 2 for f in ckpt.clients):
+                self._sparse_rows_ok = False
         if ckpt.accountant_state:
             self.accountant.load_state_dict(ckpt.accountant_state)
         if ckpt.throughput:
@@ -534,14 +634,15 @@ class FedModel:
         multihost.local_row_slice): per-process batch feeding — no host
         materializes the global batch."""
         client_ids, data, mask = batch
-        # donation contract (Config.donate_round_state): the per-round
-        # jit donates the ClientState operand — self.clients is
-        # reassigned from the result below and never read in between.
-        # ServerState is deliberately NOT donated on this path: the
-        # prev_weights reference captured here is read AFTER dispatch
-        # for the one-round-lagged accounting bitset, and a donated
-        # ps_weights would be a deleted buffer by then
-        # (round.ROUND_DEAD_ARGNUMS is the authoritative declaration).
+        # donation contract (Config.donate_round_state): the round jit
+        # donates the gathered CohortState and the scatter-back jit
+        # donates the full ClientState — self.clients is reassigned
+        # from the result below and never read in between. ServerState
+        # is deliberately NOT donated on this path: the prev_weights
+        # reference captured here is read AFTER dispatch for the
+        # one-round-lagged accounting bitset, and a donated ps_weights
+        # would be a deleted buffer by then (round.ROUND_DEAD_ARGNUMS /
+        # SCATTER_DEAD_ARGNUMS are the authoritative declarations).
         prev_weights = self.server.ps_weights
 
         this_round = self._rounds_done
@@ -578,6 +679,12 @@ class FedModel:
                 else mh.globalize(self.mesh, P(), work)),
             lr, self._key)
         self._rounds_done = this_round + 1
+        # O(cohort) checkpoint support: these rows may now differ from
+        # their init values (dropped clients' rows were written back
+        # bit-untouched, but over-including them only costs a few
+        # zero rows in the sparse save)
+        self._touched.update(
+            int(i) for i in np.asarray(client_ids).reshape(-1))
 
         # Communication accounting with ONE round of lag: this round's
         # change bitset is dispatched and its device->host copy started
@@ -626,7 +733,10 @@ class FedModel:
         [N, W, B, ...]; mask: [N, W, B]; lrs: [N].
 
         Returns (losses [N, W], metrics [N, W]..., download, upload)
-        with download/upload summed over the span. account=False
+        with download/upload the span's total BYTES (scalars — the
+        accountant's per-round rows are cohort-indexed since ISSUE 9,
+        so there is no population-length vector to hand back, and
+        every caller only ever consumed the totals). account=False
         returns zeros and skips the per-round popcount work, but the
         [N, D/32] bitset transfer and staleness bookkeeping still
         happen so later accounted rounds stay correct.
@@ -743,9 +853,16 @@ class FedModel:
             on_retry=_journal_retry)
         t_dispatched = time.monotonic()
         self._rounds_done = first + n_rounds
+        self._touched.update(
+            int(i) for i in np.asarray(ids_host).reshape(-1))
 
-        download = np.zeros(self.num_clients)
-        upload = np.zeros(self.num_clients)
+        # span byte totals (the accountant's per-round rows are
+        # COHORT-indexed since ISSUE 9 — a population-length vector
+        # per round was exactly the O(num_clients) host cost this
+        # refactor removes; callers of this method only ever consumed
+        # the totals)
+        download = np.float64(0.0)
+        upload = np.float64(0.0)
         # explicit device_get (not np.asarray): run_rounds is
         # transfer-guard-clean end to end — tests arm
         # analysis/runtime.forbid_transfers around the whole call
@@ -764,8 +881,8 @@ class FedModel:
                 d, u = self.accountant.record_round(
                     ids_host[n], self._prev_change_words,
                     survivors=surv_n)
-                download += d
-                upload += u
+                download += d.sum()
+                upload += u.sum()
                 comm_rows.append((float(d.sum()), float(u.sum())))
             else:
                 # keep the change deque and staleness counters in sync

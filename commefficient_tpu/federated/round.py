@@ -14,10 +14,17 @@ over the `clients` mesh axis:
   * NCCL reduce           -> `lax.psum` of the compressed quantity
 
 Per-client persistent state (errors/velocities/stale weights,
-reference fed_aggregator.py:105-129) lives as [num_clients, ...] device
-arrays; participant rows are gathered before the shard_map and
-scattered back after — the gather/scatter pattern called out as hard
-part #3 in SURVEY.md §7.3.
+reference fed_aggregator.py:105-129) lives as [padded_population, ...]
+device arrays sharded `P('clients', None)` across hosts. Since ISSUE 9
+the participant-row motion happens OUTSIDE the jitted round: a
+dedicated cohort-GATHER program pulls the sampled rows into a
+[num_workers, ...] CohortState before dispatch, and a SCATTER-BACK
+program writes the updated rows after — so the three traced round
+programs see only O(cohort) operands, never a population-shaped
+buffer (graftaudit AU004 now hard-errors on one), and device traffic
+per round is O(active) regardless of the population size. The
+gather/scatter pair (SURVEY.md hard part #3) are the only two
+programs allowed to touch the [population, D] blocks.
 
 True-top-k momentum factor masking of client velocities — broken in
 the reference via an unset global (SURVEY.md §7.4 D6) — is just data
@@ -52,12 +59,50 @@ class ServerState(NamedTuple):
 
 
 class ClientState(NamedTuple):
-    """Per-client persistent state, [num_clients, ...] rows (reference
-    shared-memory arrays at fed_aggregator.py:105-129). Fields are
-    zero-size placeholders when the config doesn't need them."""
-    errors: jax.Array            # [num_clients, D] or [0]
-    velocities: jax.Array        # [num_clients, D] or [0]
-    weights: jax.Array           # [num_clients, D] (topk_down) or [0]
+    """Per-client persistent state, [padded_population, ...] rows
+    (reference shared-memory arrays at fed_aggregator.py:105-129),
+    sharded over the mesh's clients axis (CLIENT_STATE_RULES). Fields
+    are zero-size placeholders when the config doesn't need them.
+
+    The jitted round NEVER takes this treedef as an operand: only the
+    cohort-gather and scatter-back state-motion programs touch it
+    (module docstring; graftaudit AU004 enforces the contract)."""
+    errors: jax.Array            # [padded_population, D] or [0]
+    velocities: jax.Array        # [padded_population, D] or [0]
+    weights: jax.Array           # [padded_population, D] or [0]
+
+
+class CohortState(NamedTuple):
+    """The gathered participant rows one round operates on —
+    [num_workers, D] per tracked block, or a [num_workers] f32 dummy
+    when the config doesn't track that block (the dummies keep the
+    shard_map operand count static; they are never read).
+
+    Produced by the cohort-gather program, consumed and returned
+    (merged: dropped clients keep their gathered values) by the jitted
+    round, written back by the scatter-back program. O(cohort) in every
+    dimension — this treedef is what makes the round programs
+    population-free."""
+    errors: jax.Array            # [num_workers, D] or [num_workers]
+    velocities: jax.Array        # [num_workers, D] or [num_workers]
+    weights: jax.Array           # [num_workers, D] or [num_workers]
+
+
+# partition rules for the persistent client-state blocks — the
+# match_partition_rules pattern (SNIPPETS.md [1], parallel/multihost)
+# applied to the one treedef that matters at population scale: every
+# live [padded_population, D] row block shards over the clients axis,
+# placeholders/scalars replicate (the helper's ndim guard).
+CLIENT_STATE_RULES = (
+    (r"\.(errors|velocities|weights)$", P("clients", None)),
+)
+
+
+def client_state_specs(state) -> "ClientState":
+    """PartitionSpec tree for a ClientState (or any same-treedef value)
+    via CLIENT_STATE_RULES."""
+    from commefficient_tpu.parallel import multihost as mh
+    return mh.match_partition_rules(CLIENT_STATE_RULES, state)
 
 
 class RoundBatch(NamedTuple):
@@ -196,16 +241,27 @@ def _has_velocities(cfg): return cfg.local_momentum > 0
 
 # the three traced round programs, in the order the fault machinery
 # grows them (ROADMAP invariant; analysis/runtime.assert_program_count
-# proves the count dynamically, graftaudit walks each one statically)
+# proves the count dynamically, graftaudit walks each one statically).
+# Since ISSUE 9 each round program operates on CohortState rows; the
+# cohort-gather and scatter-back STATE-MOTION programs compile once
+# per config alongside them (STATE_MOTION_PROGRAMS) and are the only
+# programs whose operands may carry the population dimension.
 PROGRAM_VARIANTS = ("mask_free", "dropout", "dropout_stragglers")
 
-# per-round dispatch (TrainRound.__call__): ClientState is dead — the
-# caller (FedModel._call_train, every test) reassigns it from the
-# result — but ServerState is NOT: _call_train reads the previous
+# the two state-motion programs every TrainRound dispatch brackets the
+# round program with (compiled once; cache hits thereafter)
+STATE_MOTION_PROGRAMS = ("gather", "scatter")
+
+# per-round dispatch (TrainRound.__call__, three programs): the
+# gathered CohortState is dead after the round program — the caller
+# scatters the RETURNED rows — and the full ClientState is dead after
+# scatter-back (the caller reassigns it from the result), so both are
+# donated. ServerState is NOT: _call_train reads the previous
 # ps_weights AFTER dispatch for the one-round-lagged accounting bitset,
 # so donating it would hand accounting a deleted buffer. graftaudit's
-# donation audit uses exactly this declaration.
-ROUND_DEAD_ARGNUMS = (1,)
+# donation audit uses exactly these declarations.
+ROUND_DEAD_ARGNUMS = (1,)      # round program: the CohortState operand
+SCATTER_DEAD_ARGNUMS = (0,)    # scatter-back: the full ClientState
 # scanned-span dispatch (TrainRound.train_rounds): both state operands
 # are dead — run_rounds computes the change bitset INSIDE the span and
 # assigns all state from the result.
@@ -479,24 +535,64 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         axis_names=frozenset({"clients"}),
     )
 
+    # ---------------- cohort gather / scatter-back -----------------------
+    # The participant-row motion lives in two dedicated STATE-MOTION
+    # programs OUTSIDE the jitted round (module docstring): the round
+    # programs therefore never see a population-shaped operand —
+    # graftaudit AU004's hard-error contract — and the only programs
+    # touching the sharded [padded_population, D] blocks move exactly
+    # O(cohort) rows each. Both compile once per config and are cache
+    # hits on every later dispatch (tests pin the counts).
+
+    def gather_cohort(clients: ClientState, ids) -> CohortState:
+        """Pull the sampled cohort's rows out of the sharded population
+        blocks. Untracked blocks yield [W] f32 dummies (distinct
+        buffers — the round jit donates the whole CohortState, and XLA
+        rejects one buffer donated twice) that keep the shard_map
+        operand count static; they are never read."""
+        W = ids.shape[0]
+        return CohortState(
+            errors=(clients.errors[ids] if _has_errors(cfg)
+                    else jnp.zeros((W,))),
+            velocities=(clients.velocities[ids] if _has_velocities(cfg)
+                        else jnp.zeros((W,))),
+            weights=(clients.weights[ids] if cfg.do_topk_down
+                     else jnp.zeros((W,))))
+
+    def scatter_back(clients: ClientState, ids,
+                     cohort: CohortState) -> ClientState:
+        """Write the round's merged cohort rows back into the sharded
+        population blocks. The rows already encode the dropout
+        contract (round_step merged dropped clients' gathered values
+        back), so this is an unconditional per-slot write; untracked
+        placeholder fields pass through."""
+        new_clients = clients
+        if _has_errors(cfg):
+            new_clients = new_clients._replace(
+                errors=new_clients.errors.at[ids].set(cohort.errors))
+        if _has_velocities(cfg):
+            new_clients = new_clients._replace(
+                velocities=new_clients.velocities.at[ids].set(
+                    cohort.velocities))
+        if cfg.do_topk_down:
+            new_clients = new_clients._replace(
+                weights=new_clients.weights.at[ids].set(cohort.weights))
+        return new_clients
+
     # ---------------- full train round ----------------------------------
-    def round_step(server: ServerState, clients: ClientState,
+    def round_step(server: ServerState, cohort: CohortState,
                    batch: RoundBatch, lr, key):
         num_workers = batch.client_ids.shape[0]
         if num_workers % n_shards != 0:
             raise ValueError(
                 f"num_workers={num_workers} must be divisible by the "
                 f"{n_shards}-way clients mesh axis")
-        D = cfg.grad_size
 
-        # gather participant rows of persistent client state
-        ids = batch.client_ids
-        err_rows = (clients.errors[ids] if _has_errors(cfg)
-                    else jnp.zeros((num_workers,)))
-        vel_rows = (clients.velocities[ids] if _has_velocities(cfg)
-                    else jnp.zeros((num_workers,)))
-        w_rows = (clients.weights[ids] if cfg.do_topk_down
-                  else jnp.zeros((num_workers,)))
+        # the gathered participant rows (cohort-gather ran before
+        # dispatch; zero population-shaped operands in this program)
+        err_rows = cohort.errors
+        vel_rows = cohort.velocities
+        w_rows = cohort.weights
 
         round_key = jax.random.fold_in(key, server.round_idx)
         client_keys = jax.vmap(
@@ -561,25 +657,26 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         new_server = ServerState(new_ps, upd.Vvelocity, upd.Verror,
                                  server.round_idx + 1)
 
-        # scatter updated participant rows back; a dropped client's
-        # rows are re-written with their GATHERED values, i.e. land
-        # bit-untouched (its error feedback simply waits for the next
-        # round it completes)
+        # merge the updated participant rows with the gathered ones: a
+        # dropped client's rows come through as their GATHERED values,
+        # i.e. the scatter-back lands them bit-untouched (its error
+        # feedback simply waits for the next round it completes). The
+        # merged CohortState is this program's carried row output —
+        # the scatter-back state-motion program writes it into the
+        # population blocks after dispatch.
         keep = None if surv is None else surv[:, None] > 0
-        new_clients = clients
+        new_cohort = cohort
         if _has_errors(cfg):
             if keep is not None:
                 new_err = jnp.where(keep, new_err, err_rows)
-            new_clients = new_clients._replace(
-                errors=new_clients.errors.at[ids].set(new_err))
+            new_cohort = new_cohort._replace(errors=new_err)
         if _has_velocities(cfg):
             if upd.velocity_mask is not None:
                 # true_topk momentum factor masking (fixes ref D6)
                 new_vel = new_vel * upd.velocity_mask[None, :]
             if keep is not None:
                 new_vel = jnp.where(keep, new_vel, vel_rows)
-            new_clients = new_clients._replace(
-                velocities=new_clients.velocities.at[ids].set(new_vel))
+            new_cohort = new_cohort._replace(velocities=new_vel)
         if cfg.do_topk_down:
             # persist each participant's post-download weights so its
             # staleness is tracked (the reference computes but never
@@ -588,8 +685,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             # stale-weight row is kept too
             if keep is not None:
                 new_w = jnp.where(keep, new_w, w_rows)
-            new_clients = new_clients._replace(
-                weights=new_clients.weights.at[ids].set(new_w))
+            new_cohort = new_cohort._replace(weights=new_w)
 
         # on-device telemetry (telemetry/metrics.py): pure observation
         # of values already computed — reads the applied delta and the
@@ -606,19 +702,63 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         else:
             tele = tmetrics.empty_vector()
 
-        return new_server, new_clients, RoundMetrics(
+        return new_server, new_cohort, RoundMetrics(
             losses, metrics, counts, tele)
+
+    def round_full(server: ServerState, clients: ClientState,
+                   batch: RoundBatch, lr, key):
+        """The COMPOSED per-round body — cohort gather, cohort round,
+        scatter-back in ONE traced program. This is the scanned span's
+        step (client state rides the scan carry, so the gather/scatter
+        happen per scanned round exactly as before the split) and the
+        bit-identity twin tests compare the three-program dispatch
+        against."""
+        cohort = gather_cohort(clients, batch.client_ids)
+        server, new_cohort, metrics = round_step(
+            server, cohort, batch, lr, key)
+        clients = scatter_back(clients, batch.client_ids, new_cohort)
+        return server, clients, metrics
+
+    # explicit output placement for the state-motion programs (the
+    # shard-and-gather-fn half of the SNIPPETS.md pattern): gathered
+    # rows land sharded over the clients axis — the exact layout the
+    # round program's shard_map consumes, so GSPMD never reshards the
+    # cohort between the two dispatches — and the scattered population
+    # blocks keep their CLIENT_STATE_RULES placement.
+    def _cohort_sharding():
+        from commefficient_tpu.parallel import multihost as mh
+
+        def spec(tracked):
+            return P("clients", None) if tracked else P("clients")
+        return mh.shardings(mesh, CohortState(
+            spec(_has_errors(cfg)), spec(_has_velocities(cfg)),
+            spec(cfg.do_topk_down)))
+
+    def _state_sharding():
+        from commefficient_tpu.parallel import multihost as mh
+
+        def spec(tracked):
+            return P("clients", None) if tracked else P()
+        return mh.shardings(mesh, ClientState(
+            spec(_has_errors(cfg)), spec(_has_velocities(cfg)),
+            spec(cfg.do_topk_down)))
 
     # buffer donation (Config.donate_round_state, default on): the
     # dead-after-dispatch state operands are donated so XLA reuses
     # their HBM for the matching outputs in place — at population
     # scale the client rows are the dominant allocation, and an
-    # un-donated round transiently doubles it. The dead sets are the
-    # registry constants above; donated operands are INVALID after the
-    # call (see TrainRound docstring for the caller contract).
+    # un-donated scatter-back transiently doubles it. The dead sets are
+    # the registry constants above; donated operands are INVALID after
+    # the call (see TrainRound docstring for the caller contract).
     round_donate = (ROUND_DEAD_ARGNUMS if cfg.donate_round_state
                     else ())
+    scatter_donate = (SCATTER_DEAD_ARGNUMS if cfg.donate_round_state
+                      else ())
     span_donate = SPAN_DEAD_ARGNUMS if cfg.donate_round_state else ()
+    _gather_jit = jax.jit(gather_cohort,
+                          out_shardings=_cohort_sharding())
+    _scatter_jit = jax.jit(scatter_back, donate_argnums=scatter_donate,
+                           out_shardings=_state_sharding())
     _train_round_jit = jax.jit(round_step, donate_argnums=round_donate)
 
     # ---------------- scanned multi-round driver -------------------------
@@ -642,7 +782,11 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             server, clients = carry
             batch, lr = xs
             prev = server.ps_weights
-            server, clients, metrics = round_step(
+            # the composed body: gather -> cohort round -> scatter all
+            # inside the scanned program, client state on the carry —
+            # the population blocks never leave the device between
+            # rounds, exactly as before the state-motion split
+            server, clients, metrics = round_full(
                 server, clients, batch, lr, key)
             bits = pack_change_bits(server.ps_weights - prev)
             return (server, clients), (metrics, bits)
@@ -657,26 +801,47 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         """Callable single-round step; `.train_rounds` runs a whole
         scanned span of rounds in one device program.
 
+        `__call__` brackets the jitted ROUND program with the two
+        state-motion programs: cohort-gather before, scatter-back
+        after — three dispatches, but the gather/scatter compile once
+        per config and the round program is one of exactly three
+        treedef variants, so the steady state is three cache-hit
+        dispatches with O(cohort) traffic between them.
+
         Caller contract under donation (Config.donate_round_state, the
-        default): `__call__` donates the ClientState operand and
+        default): `__call__` donates the gathered CohortState into the
+        round program and the full ClientState into scatter-back, and
         `.train_rounds` donates BOTH state operands — after a dispatch
         the caller must use the returned state, never the arrays it
         passed in (FedModel reassigns immediately; a timing loop that
         re-dispatches from one retained state object needs
         donate_round_state=False). The registry attributes below are
         graftaudit's trace surface: `round_step` is the un-jitted
-        single-round program body (what both jits compile — jax.
-        make_jaxpr over it yields the audited ClosedJaxpr), and the
-        *_donate_argnums record what the built jits actually donate,
-        checked against ROUND_DEAD_ARGNUMS / SPAN_DEAD_ARGNUMS."""
+        COHORT round body (what the round jit compiles — jax.make_jaxpr
+        over it yields the audited ClosedJaxpr), `gather_fn` /
+        `scatter_fn` are the raw state-motion bodies, `round_full` the
+        composed scan step, and the *_donate_argnums record what the
+        built jits actually donate, checked against
+        ROUND_DEAD_ARGNUMS / SCATTER_DEAD_ARGNUMS / SPAN_DEAD_ARGNUMS."""
 
         def __call__(self, server, clients, batch, lr, key):
-            return _train_round_jit(server, clients, batch, lr, key)
+            cohort = _gather_jit(clients, batch.client_ids)
+            server, new_cohort, metrics = _train_round_jit(
+                server, cohort, batch, lr, key)
+            clients = _scatter_jit(clients, batch.client_ids,
+                                   new_cohort)
+            return server, clients, metrics
 
     handle = TrainRound()
     handle.train_rounds = train_rounds
     handle.round_step = round_step
+    handle.round_full = round_full
+    handle.gather = _gather_jit
+    handle.scatter = _scatter_jit
+    handle.gather_fn = gather_cohort
+    handle.scatter_fn = scatter_back
     handle.round_donate_argnums = round_donate
+    handle.scatter_donate_argnums = scatter_donate
     handle.span_donate_argnums = span_donate
     handle.cfg = cfg
     return handle

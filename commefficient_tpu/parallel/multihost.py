@@ -158,6 +158,45 @@ def is_multihost() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# partition rules (the `match_partition_rules` / shard-and-gather-fn
+# pattern of SNIPPETS.md [1], reduced to what the round engine needs)
+
+
+def match_partition_rules(rules, tree, default: P = P()):
+    """Map every leaf of `tree` to a PartitionSpec by regex over its
+    tree path (SNIPPETS.md [1] `match_partition_rules`): the first
+    `(pattern, spec)` whose pattern searches the leaf's keystr path
+    wins. A leaf with fewer dims than the matched spec's length —
+    zero-size placeholders, scalars — falls back to `default`, so an
+    unused state field never claims a mesh axis it cannot divide.
+
+    Returns a pytree of PartitionSpecs with `tree`'s treedef — feed it
+    to `shardings()` for jit in/out_shardings, or zip it with the
+    leaves for explicit device_put placement."""
+    import re
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        spec = default
+        for pat, s in rules:
+            if re.search(pat, name) and getattr(leaf, "ndim", 0) >= len(s):
+                spec = s
+                break
+        out.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def shardings(mesh: Mesh, specs):
+    """A pytree of PartitionSpecs -> the matching NamedShardings on
+    `mesh` (the make_shard_and_gather_fns half the jit API needs:
+    jit(..., out_shardings=shardings(mesh, specs)))."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P))
+
+
+# ---------------------------------------------------------------------------
 # array construction
 
 

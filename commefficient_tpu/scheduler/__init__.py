@@ -184,9 +184,9 @@ class RoundScheduler:
         the tracker's observed completion ratio once it has seen at
         least one full round of participations, else the config's
         1 - client_dropout prior."""
-        part = int(self.tracker.participations.sum())
+        part = int(self.tracker.total_participations)
         if part >= max(self.cfg.num_workers, 1):
-            return float(self.tracker.completions.sum()) / part
+            return float(self.tracker.total_completions) / part
         return 1.0 - float(self.cfg.client_dropout)
 
     def commit_round(self, client_ids: np.ndarray,
@@ -242,7 +242,7 @@ class RoundScheduler:
 
     # ---------------- checkpoint round-trip (bit-exact) ------------------
     def state_dict(self) -> dict:
-        return {
+        out = {
             "rounds_scheduled": np.int64(self.rounds_scheduled),
             "clients_sampled": np.int64(self.clients_sampled),
             "deadline_rounds": np.int64(self.deadline_rounds),
@@ -250,6 +250,12 @@ class RoundScheduler:
             "last_deadline_s": np.float64(self.last_deadline_s),
             "rounds_committed": np.int64(self.rounds_committed),
         }
+        # policy-owned state rides along (the throughput sampler's
+        # alias-table snapshot + rebuild counter, ISSUE 9): same
+        # sched_* checkpoint namespace, same bit-exact-resume contract
+        if hasattr(self.policy, "state_dict"):
+            out.update(self.policy.state_dict())
+        return out
 
     def load_state_dict(self, state: dict) -> None:
         self.rounds_scheduled = int(np.asarray(
@@ -263,6 +269,8 @@ class RoundScheduler:
         # to the round count already tallied
         self.rounds_committed = int(np.asarray(state.get(
             "rounds_committed", state["rounds_scheduled"])))
+        if hasattr(self.policy, "load_state_dict"):
+            self.policy.load_state_dict(state)
 
 
 def attach_round_scheduler(model, train_loader) -> RoundScheduler:

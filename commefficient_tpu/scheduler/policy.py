@@ -77,6 +77,46 @@ class UniformSampler(ParticipantSampler):
         return rng.choice(alive, num_slots, replace=False)
 
 
+class AliasTable:
+    """Walker/Vose alias table: O(n) build, O(1) per draw from a fixed
+    unnormalized weight set. The million-client sampler primitive —
+    `gen.choice(p=...)` re-normalizes and walks an O(n) distribution
+    EVERY round, which is exactly the per-round population-length cost
+    ISSUE 9 removes. The build is deterministic (stable partition of
+    under/over-full columns), so a table rebuilt from checkpointed
+    snapshot rates is bit-identical to the one the crashed run held.
+    """
+
+    def __init__(self, ids: np.ndarray, weights: np.ndarray):
+        ids = np.asarray(ids, np.int64)
+        w = np.asarray(weights, np.float64)
+        assert len(ids) == len(w) and (w > 0).all()
+        n = len(ids)
+        self.ids = ids
+        self.n = n
+        p = w * (n / w.sum())
+        prob = np.ones(n)
+        alias = np.arange(n)
+        small = [i for i in range(n) if p[i] < 1.0]
+        large = [i for i in range(n) if p[i] >= 1.0]
+        while small and large:
+            s, l = small.pop(), large.pop()
+            prob[s] = p[s]
+            alias[s] = l
+            p[l] = (p[l] + p[s]) - 1.0
+            (small if p[l] < 1.0 else large).append(l)
+        self.prob = prob
+        self.alias = alias
+
+    def draw(self, gen) -> int:
+        """One O(1) draw -> global client id (two uniforms, fixed
+        consumption order so the stream is replayable)."""
+        col = int(gen.integers(self.n))
+        if gen.random() < self.prob[col]:
+            return int(self.ids[col])
+        return int(self.ids[self.alias[col]])
+
+
 class ThroughputAwareSampler(ParticipantSampler):
     """Weighted draw favoring fast clients, with an exploration floor.
 
@@ -100,15 +140,41 @@ class ThroughputAwareSampler(ParticipantSampler):
     (their EMA can recover) instead of starving forever —
     tests/test_scheduler.py checks the empirical distribution.
 
+    O(1)-per-draw mechanics (ISSUE 9): the biased component lives in
+    an ALIAS TABLE over the tracker's measured clients
+    (O(clients-ever-seen), never O(population)), rebuilt only when
+    the EMAs have changed MATERIALLY since the last build
+    (`rebuild_tol` relative change, or a new measured client). Each
+    slot draw decomposes the mixture exactly:
+
+      * with prob `explore_floor`: one uniform index into `alive`;
+      * else, biased: measured-vs-unmeasured sub-component chosen by
+        their exact probability masses over the alive set, then one
+        alias-table draw (rejecting non-alive ids — restriction +
+        renormalization is exactly the conditional distribution) or
+        one uniform draw over the unmeasured alive.
+
+    Duplicate draws are rejected (sequentially identical in
+    distribution to `gen.choice(replace=False, p=...)`, which
+    renormalizes over the un-drawn set). Per-round host work is
+    O(cohort + measured), with a population-length weight vector never
+    materialized; a pathological rejection streak (cohort ~ alive set,
+    or alive a sliver of the measured set) falls back deterministically
+    to the exact `gen.choice` draw on a fresh sub-seeded generator.
+
     Draws come from a counter-based generator over (seed, SCHED_DOMAIN,
     round_idx): stateless between rounds, so crash->resume replays the
-    identical choice for any round from checkpointed tracker state.
+    identical choice for any round from checkpointed tracker state
+    PLUS the alias snapshot (`state_dict` — the rebuild counter and
+    the rate snapshot the live table was built from; the table
+    itself is rebuilt bit-identically from the snapshot at resume).
     """
 
     name = "throughput"
 
     def __init__(self, seed: int, tracker: ClientThroughputTracker,
-                 explore_floor: float = 0.1, speed_bias: float = 2.0):
+                 explore_floor: float = 0.1, speed_bias: float = 2.0,
+                 rebuild_tol: float = 0.05):
         if not 0.0 <= explore_floor <= 1.0:
             raise ValueError(
                 f"explore_floor={explore_floor} must be in [0, 1] "
@@ -121,10 +187,23 @@ class ThroughputAwareSampler(ParticipantSampler):
         self.tracker = tracker
         self.explore_floor = float(explore_floor)
         self.speed_bias = float(speed_bias)
+        self.rebuild_tol = float(rebuild_tol)
+        # alias-table state: the table, the (ids, rates) snapshot it
+        # was built from, the tracker version the snapshot was checked
+        # against, and the rebuild counter (checkpointed; bit-exact
+        # resume proof in tests/test_population.py)
+        self._table: "AliasTable | None" = None
+        self._snap_ids = np.zeros((0,), np.int64)
+        self._snap_rates = np.zeros((0,), np.float64)
+        self._snap_version = -1
+        self.rebuilds = 0
 
+    # -- distribution definition (shared by both draw paths) --------------
     def weights(self, alive: np.ndarray) -> np.ndarray:
-        """Normalized selection probabilities over `alive` (exposed for
-        the fairness tests)."""
+        """Normalized selection probabilities over `alive` (the
+        distribution CONTRACT — the alias path realizes exactly this,
+        up to the snapshot lag of `rebuild_tol`; exposed for the
+        fairness/equivalence tests and the exact fallback)."""
         alive = np.asarray(alive, np.int64)
         rates = self.tracker.examples_per_sec(alive).astype(np.float64)
         measured = rates > 0
@@ -141,12 +220,182 @@ class ThroughputAwareSampler(ParticipantSampler):
         p = (1.0 - f) * p + f / len(alive)
         return p / p.sum()
 
+    # -- alias-table lifecycle --------------------------------------------
+    def _maybe_rebuild(self) -> None:
+        """Rebuild the alias table iff the tracker EMAs changed
+        materially since the snapshot: any new measured client, any
+        rate moved by more than `rebuild_tol` relative. The
+        tracker-version fast path makes the steady state O(1)."""
+        if self.tracker.version == self._snap_version:
+            return
+        ids, rates = self.tracker.measured()
+        rates = rates.astype(np.float64)
+        self._snap_version = self.tracker.version
+        if len(ids) == len(self._snap_ids) and \
+                np.array_equal(ids, self._snap_ids):
+            prev = self._snap_rates
+            denom = np.maximum(np.abs(prev), 1e-30)
+            if len(ids) == 0 or \
+                    float(np.max(np.abs(rates - prev) / denom)) \
+                    <= self.rebuild_tol:
+                return
+        self._rebuild(ids, rates)
+
+    def _rebuild(self, ids: np.ndarray, rates: np.ndarray) -> None:
+        self._snap_ids = np.asarray(ids, np.int64)
+        self._snap_rates = np.asarray(rates, np.float64)
+        if len(ids):
+            rmax = float(self._snap_rates.max())
+            w = (self._snap_rates / rmax) ** self.speed_bias
+            self._table = AliasTable(self._snap_ids, w)
+        else:
+            self._table = None
+        self.rebuilds += 1
+
+    # -- the draw ----------------------------------------------------------
     def select(self, alive, num_slots, rng, round_idx):
-        alive = np.asarray(alive, np.int64)
+        # sorted is a REQUIREMENT of the searchsorted membership test
+        # below, not an assumption: the in-repo producer (np.where in
+        # data/sampler.epoch) is sorted so this is the identity there,
+        # and an unsorted caller gets a correct draw over the same SET
+        # instead of silently misclassified membership
+        alive = np.sort(np.asarray(alive, np.int64))
+        num_slots = int(num_slots)
         gen = np.random.default_rng(np.random.SeedSequence(
             [self.seed, SCHED_DOMAIN, int(round_idx)]))
-        return gen.choice(alive, size=int(num_slots), replace=False,
-                          p=self.weights(alive))
+        self._maybe_rebuild()
+        table = self._table
+        if table is None:
+            # nothing measured yet: pure uniform draw over alive —
+            # O(num_slots) rejection, no weight vector
+            return self._draw_uniform(gen, alive, num_slots, round_idx)
+
+        # snapshot rates restricted to the alive set: O(measured)
+        # membership via a sorted search against `alive` (np.where
+        # output is sorted). med/max over measured-ALIVE reproduce
+        # weights()' alive-dependent normalization exactly.
+        pos = np.searchsorted(alive, table.ids)
+        pos = np.minimum(pos, len(alive) - 1)
+        m_alive = alive[pos] == table.ids
+        n_measured_alive = int(m_alive.sum())
+        n_unmeasured_alive = len(alive) - n_measured_alive
+        if n_measured_alive == 0:
+            return self._draw_uniform(gen, alive, num_slots, round_idx)
+        r_alive = self._snap_rates[m_alive]
+        rmax = float(r_alive.max())
+        mass_measured = float(((r_alive / rmax)
+                               ** self.speed_bias).sum())
+        med = float(np.median(r_alive))
+        w_unmeasured = (med / rmax) ** self.speed_bias
+        mass_unmeasured = n_unmeasured_alive * w_unmeasured
+        p_unmeasured = mass_unmeasured / (mass_measured
+                                          + mass_unmeasured)
+        measured_set = set(int(c) for c in table.ids[m_alive])
+
+        chosen: list = []
+        chosen_set: set = set()
+        f = self.explore_floor
+        # rejection budget: past this the round degenerates (cohort ~
+        # alive, or alive a sliver of the table) and the exact path is
+        # both correct and affordable — deterministic fallback on a
+        # fresh sub-seeded stream. Shared across the whole round,
+        # decremented per elementary draw.
+        budget = [64 * num_slots + 256]
+
+        def spend() -> bool:
+            budget[0] -= 1
+            return budget[0] > 0
+
+        # Each slot: pick a mixture component ONCE, then draw the
+        # component's CONDITIONAL distribution by rejecting inside
+        # that component — re-flipping the component on a rejection
+        # would re-weight the mixture (it suppressed the unmeasured
+        # mass by the alive fraction when first written). Only the
+        # duplicate rejection restarts the whole draw: conditioning
+        # the full mixture on "not already chosen" is exactly the
+        # sequential without-replacement distribution gen.choice
+        # realizes.
+        while len(chosen) < num_slots and spend():
+            if f > 0 and gen.random() < f:
+                cand = int(alive[int(gen.integers(len(alive)))])
+            elif gen.random() < p_unmeasured:
+                # uniform over the unmeasured alive: rejection from
+                # alive against the measured-alive membership set
+                cand = None
+                while spend():
+                    c = int(alive[int(gen.integers(len(alive)))])
+                    if c not in measured_set:
+                        cand = c
+                        break
+                if cand is None:
+                    break
+            else:
+                # the table covers ALL measured clients; rejecting the
+                # not-alive ones yields the restricted-renormalized
+                # conditional — the exact measured-alive distribution
+                cand = None
+                while spend():
+                    c = table.draw(gen)
+                    if c in measured_set:
+                        cand = c
+                        break
+                if cand is None:
+                    break
+            if cand in chosen_set:
+                continue
+            chosen.append(cand)
+            chosen_set.add(cand)
+        if len(chosen) < num_slots:
+            gen_fb = np.random.default_rng(np.random.SeedSequence(
+                [self.seed, SCHED_DOMAIN, int(round_idx), 1]))
+            return gen_fb.choice(alive, size=num_slots, replace=False,
+                                 p=self.weights(alive))
+        return np.asarray(chosen, np.int64)
+
+    def _draw_uniform(self, gen, alive, num_slots, round_idx):
+        chosen: list = []
+        seen: set = set()
+        budget = 64 * num_slots + 256
+        while len(chosen) < num_slots and budget > 0:
+            budget -= 1
+            cand = int(alive[int(gen.integers(len(alive)))])
+            if cand in seen:
+                continue
+            chosen.append(cand)
+            seen.add(cand)
+        if len(chosen) < num_slots:
+            gen_fb = np.random.default_rng(np.random.SeedSequence(
+                [self.seed, SCHED_DOMAIN, int(round_idx), 1]))
+            return gen_fb.choice(alive, size=num_slots, replace=False)
+        return np.asarray(chosen, np.int64)
+
+    # -- checkpoint round-trip (bit-exact; rides in sched_* keys) ----------
+    def state_dict(self) -> dict:
+        return {
+            "alias_rebuilds": np.int64(self.rebuilds),
+            "alias_ids": self._snap_ids.copy(),
+            "alias_rates": self._snap_rates.copy(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if "alias_rebuilds" not in state:
+            return  # legacy checkpoint: first select() builds fresh
+        ids = np.asarray(state.get("alias_ids", ()), np.int64)
+        rates = np.asarray(state.get("alias_rates", ()), np.float64)
+        if len(ids):
+            # _rebuild bumps the counter; the restored value below is
+            # authoritative either way
+            self._rebuild(ids, rates)
+        self.rebuilds = int(np.asarray(state["alias_rebuilds"]))
+        # force the material-change CHECK on the first post-resume
+        # select: the crashed run may have had a pending tracker
+        # update since this snapshot was taken, and its next select
+        # would have checked. The check is a pure idempotent function
+        # of (current rates, snapshot basis), so running it once more
+        # than the uninterrupted run can never flip the rebuild
+        # decision — resume replays the identical table and therefore
+        # the identical draw stream (tests/test_population.py).
+        self._snap_version = -1
 
 
 def make_sampler(cfg, tracker: ClientThroughputTracker

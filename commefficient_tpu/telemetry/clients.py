@@ -17,8 +17,17 @@ true round time once the device is the bottleneck). Dropped clients
 arrive with zero examples: their participation is counted but their
 EMA is untouched (a dead round says nothing about their speed).
 
-Persistence: `state_dict`/`load_state_dict` round-trip plain numpy
-arrays bit-exactly; utils/checkpoint embeds them under `thr_*` keys
+Storage is SPARSE (ISSUE 9): only clients that have ever been sampled
+own a row, so tracker memory and checkpoint bytes are
+O(clients-ever-seen), never O(population) — at a million-client
+population with sparse participation the dense arrays this replaced
+were ~25 MB of host state per tracker and the same again in every
+checkpoint, for rows that were all zero. Unseen clients read as
+rate 0 / zero counts, exactly what the dense zeros encoded.
+
+Persistence: `state_dict`/`load_state_dict` round-trip the sparse row
+arrays bit-exactly (`ids` + per-row records; legacy dense captures
+load transparently); utils/checkpoint embeds them under `thr_*` keys
 (next to the fingerprint, so a resume into a different client
 population fails loudly) and FedModel.load_state restores them —
 crash->resume preserves every EMA bit-exactly
@@ -31,25 +40,34 @@ pure-(state, seed, round) contract intact.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
-# state_dict keys, fixed order (checkpoint serialization contract)
-STATE_KEYS = ("rate", "participations", "completions", "busy_seconds")
+# state_dict keys, fixed order (checkpoint serialization contract):
+# `ids` maps each row to its global client id; the per-row arrays are
+# aligned with it. Legacy captures lack `ids` and carry dense
+# [num_clients] arrays instead — load_state_dict converts.
+STATE_KEYS = ("ids", "rate", "participations", "completions",
+              "busy_seconds")
 
 
 class ClientThroughputTracker:
-    """EMA examples/sec and participation accounting per client.
+    """EMA examples/sec and participation accounting per SEEN client.
 
-    rate[c]           EMA of client c's examples/sec over its COMPLETED
-                      rounds (0.0 until the first completion — callers
-                      must treat 0 as "unmeasured", see
-                      estimate_round_seconds)
-    participations[c] rounds client c was sampled into
-    completions[c]    rounds client c actually processed examples in
-    busy_seconds[c]   cumulative wall seconds of rounds c completed
-    """
+    Row semantics (rows exist only for clients ever sampled):
+
+    rate[row]           EMA of the client's examples/sec over its
+                        COMPLETED rounds (0.0 until the first
+                        completion — callers must treat 0 as
+                        "unmeasured", see estimate_round_seconds)
+    participations[row] rounds the client was sampled into
+    completions[row]    rounds the client actually processed examples in
+    busy_seconds[row]   cumulative wall seconds of completed rounds
+
+    `version` increments whenever any EMA value changes — the cheap
+    staleness signal the alias sampler's rebuild check keys on
+    (scheduler/policy.AliasTable)."""
 
     def __init__(self, num_clients: int, ema_decay: float = 0.9):
         if not 0.0 < ema_decay < 1.0:
@@ -57,10 +75,76 @@ class ClientThroughputTracker:
                 f"ema_decay={ema_decay} must be in (0, 1)")
         self.num_clients = int(num_clients)
         self.ema_decay = float(ema_decay)
-        self.rate = np.zeros(self.num_clients, np.float32)
-        self.participations = np.zeros(self.num_clients, np.int64)
-        self.completions = np.zeros(self.num_clients, np.int64)
-        self.busy_seconds = np.zeros(self.num_clients, np.float64)
+        self._slot: dict = {}                      # global id -> row
+        # row storage: capacity-backed arrays with a live-row count
+        # (`_n`), doubled on overflow — growing by concatenate per
+        # first-seen client would make cumulative copy work QUADRATIC
+        # in clients-ever-seen, on the host hot path of exactly the
+        # million-client populations this module exists for
+        self._n = 0
+        self._ids = np.zeros((0,), np.int64)       # row -> global id
+        self._rate = np.zeros((0,), np.float32)
+        self._participations = np.zeros((0,), np.int64)
+        self._completions = np.zeros((0,), np.int64)
+        self._busy = np.zeros((0,), np.float64)
+        # O(1) aggregates for the scheduler's survival estimate —
+        # summing the row arrays per round would be O(seen), fine, but
+        # these make the hot read constant-time
+        self.total_participations = 0
+        self.total_completions = 0
+        self.version = 0
+
+    # -- row bookkeeping --------------------------------------------------
+    def _grow(self, need: int) -> None:
+        """Ensure capacity for `need` live rows (geometric doubling —
+        O(1) amortized append, O(seen) peak memory)."""
+        cap = len(self._ids)
+        if need <= cap:
+            return
+        new_cap = max(need, 2 * cap, 64)
+
+        def grown(arr, dtype):
+            out = np.zeros(new_cap, dtype)
+            out[:self._n] = arr[:self._n]
+            return out
+
+        self._ids = grown(self._ids, np.int64)
+        self._rate = grown(self._rate, np.float32)
+        self._participations = grown(self._participations, np.int64)
+        self._completions = grown(self._completions, np.int64)
+        self._busy = grown(self._busy, np.float64)
+
+    def _rows_for(self, ids: np.ndarray) -> np.ndarray:
+        """Row indices for `ids`, allocating rows for first-seen
+        clients (new rows zero-initialized — identical reads to the
+        dense zeros they replace). Deduplicated: a repeated first-seen
+        id must map to ONE row, or the extra row would sit orphaned in
+        `_ids` forever. Ids outside [0, num_clients) raise — the dense
+        arrays this storage replaced bounds-checked implicitly via
+        fancy indexing, and a silently-allocated bogus row would
+        corrupt state far from the caller's bug."""
+        fresh, fresh_seen = [], set()
+        for c in ids:
+            c = int(c)
+            if not 0 <= c < self.num_clients:
+                raise ValueError(
+                    f"client id {c} out of range for a "
+                    f"{self.num_clients}-client population")
+            if c not in self._slot and c not in fresh_seen:
+                fresh.append(c)
+                fresh_seen.add(c)
+        if fresh:
+            self._grow(self._n + len(fresh))
+            for c in fresh:
+                self._slot[c] = self._n
+                self._ids[self._n] = c
+                self._n += 1
+        return np.array([self._slot[int(c)] for c in ids], np.int64)
+
+    @property
+    def seen_ids(self) -> np.ndarray:
+        """Global ids of every client that owns a row (a copy)."""
+        return self._ids[:self._n].copy()
 
     def update_round(self, client_ids, num_examples, round_seconds,
                      survivors: Optional[np.ndarray] = None,
@@ -99,29 +183,65 @@ class ClientThroughputTracker:
             if scheduled is not None:
                 surv = surv[keep]
             ex = ex * (surv > 0)
-        self.participations[ids] += 1
+        rows = self._rows_for(ids)
+        # np.add.at, not fancy-index +=: callers are documented to pass
+        # distinct ids, but if a duplicate ever slips through the
+        # unbuffered add keeps the row counters consistent with the
+        # O(1) totals — a fancy-index += would collapse the duplicate
+        # and silently desync state_dict totals across a resume
+        np.add.at(self._participations, rows, 1)
+        self.total_participations += len(rows)
         done = ex > 0
-        done_ids = ids[done]
-        self.completions[done_ids] += 1
-        self.busy_seconds[done_ids] += float(round_seconds)
+        done_rows = rows[done]
+        np.add.at(self._completions, done_rows, 1)
+        self.total_completions += int(done.sum())
+        np.add.at(self._busy, done_rows, float(round_seconds))
         if not done.any():
             return
         sample = (ex[done] / float(round_seconds)).astype(np.float32)
-        prev = self.rate[done_ids]
+        prev = self._rate[done_rows]
         d = np.float32(self.ema_decay)
         # first completion seeds the EMA with the sample itself (an
         # EMA warmed from 0 would need ~1/(1-decay) rounds to stop
         # underestimating every client)
-        first = self.completions[done_ids] <= 1
-        self.rate[done_ids] = np.where(
+        first = self._completions[done_rows] <= 1
+        self._rate[done_rows] = np.where(
             first, sample, d * prev + (np.float32(1.0) - d) * sample)
+        self.version += 1
 
     # -- consumers (deadline estimation / straggler-aware sampling) -------
     def examples_per_sec(self, client_ids=None) -> np.ndarray:
-        """Current EMA rates (a copy); 0.0 marks unmeasured clients."""
+        """EMA rates for `client_ids` (0.0 marks unmeasured/unseen
+        clients). With client_ids=None materializes the DENSE
+        [num_clients] vector — an O(population) convenience for tests
+        and small populations; production consumers (alias sampler,
+        deadline policy) always pass explicit ids or use
+        `measured()`."""
         if client_ids is None:
-            return self.rate.copy()
-        return self.rate[np.asarray(client_ids, np.int64)].copy()
+            out = np.zeros(self.num_clients, np.float32)
+            out[self._ids[:self._n]] = self._rate[:self._n]
+            return out
+        return self._lookup(self._rate, client_ids,
+                            np.float32(0.0)).astype(np.float32)
+
+    def participation_counts(self, client_ids) -> np.ndarray:
+        return self._lookup(self._participations, client_ids, 0)
+
+    def completion_counts(self, client_ids) -> np.ndarray:
+        return self._lookup(self._completions, client_ids, 0)
+
+    def _lookup(self, arr, client_ids, default):
+        ids = np.asarray(client_ids, np.int64).reshape(-1)
+        return np.array([arr[self._slot[int(c)]]
+                         if int(c) in self._slot else default
+                         for c in ids], arr.dtype)
+
+    def measured(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(ids, rates) of every client with a nonzero EMA — the alias
+        sampler's table basis; O(clients-ever-seen)."""
+        m = self._rate[:self._n] > 0
+        return (self._ids[:self._n][m].copy(),
+                self._rate[:self._n][m].copy())
 
     def estimate_round_seconds(self, client_ids, num_examples,
                                cold_start_seconds: Optional[float]
@@ -144,41 +264,94 @@ class ClientThroughputTracker:
             `cold_start_seconds` itself when nothing at all has been
             measured yet.
         """
-        ids = np.asarray(client_ids, np.int64)
         ex = np.asarray(num_examples, np.float64)
-        r = self.rate[ids].astype(np.float64)
+        r = self.examples_per_sec(client_ids).astype(np.float64)
         with np.errstate(divide="ignore"):
             out = np.where(r > 0, ex / np.maximum(r, 1e-30), np.inf)
         out = np.where(ex <= 0, 0.0, out)
         unmeasured = (r <= 0) & (ex > 0)
         if unmeasured.any() and cold_start_seconds is not None:
-            measured = self.rate[self.rate > 0]
-            if measured.size:
-                out[unmeasured] = ex[unmeasured] / float(measured.min())
+            rows = self._rate[:self._n]
+            live = rows[rows > 0]
+            if live.size:
+                out[unmeasured] = ex[unmeasured] / float(live.min())
             else:
                 out[unmeasured] = float(cold_start_seconds)
         return out
 
+    # -- test / bench hook ------------------------------------------------
+    def force(self, client_ids, rate=None, participations=None,
+              completions=None, busy_seconds=None) -> None:
+        """Directly set per-client records (rows allocated as needed).
+        Replaces the dense-array writes tests used to do
+        (`tracker.rate[:] = ...`); bumps `version` like a real
+        measurement so alias-table rebuild logic sees the change."""
+        rows = self._rows_for(
+            np.asarray(client_ids, np.int64).reshape(-1))
+        if rate is not None:
+            self._rate[rows] = np.asarray(rate, np.float32)
+            self.version += 1
+        if participations is not None:
+            new = np.asarray(participations, np.int64)
+            self.total_participations += int(
+                new.sum() - self._participations[rows].sum())
+            self._participations[rows] = new
+        if completions is not None:
+            new = np.asarray(completions, np.int64)
+            self.total_completions += int(
+                new.sum() - self._completions[rows].sum())
+            self._completions[rows] = new
+        if busy_seconds is not None:
+            self._busy[rows] = np.asarray(busy_seconds, np.float64)
+
     # -- checkpoint round-trip (bit-exact) --------------------------------
     def state_dict(self) -> dict:
+        n = self._n
         return {
-            "rate": self.rate.copy(),
-            "participations": self.participations.copy(),
-            "completions": self.completions.copy(),
-            "busy_seconds": self.busy_seconds.copy(),
+            "ids": self._ids[:n].copy(),
+            "rate": self._rate[:n].copy(),
+            "participations": self._participations[:n].copy(),
+            "completions": self._completions[:n].copy(),
+            "busy_seconds": self._busy[:n].copy(),
         }
 
     def load_state_dict(self, state: dict) -> None:
         rate = np.asarray(state["rate"], np.float32)
-        if rate.shape[0] != self.num_clients:
-            raise ValueError(
-                f"throughput state tracks {rate.shape[0]} clients; "
-                f"this run has {self.num_clients} — the checkpoint "
-                f"fingerprint should have rejected this resume")
-        self.rate = rate.copy()
-        self.participations = np.asarray(
+        if "ids" in state:
+            ids = np.asarray(state["ids"], np.int64)
+            if ids.size and ids.max() >= self.num_clients:
+                raise ValueError(
+                    f"throughput state tracks client id {ids.max()}; "
+                    f"this run has {self.num_clients} clients — the "
+                    f"checkpoint fingerprint should have rejected this "
+                    f"resume")
+        else:
+            # legacy dense capture: every client had a row; keep only
+            # the rows that carry information (any nonzero record) —
+            # the dense zeros are exactly what absent rows read as
+            if rate.shape[0] != self.num_clients:
+                raise ValueError(
+                    f"throughput state tracks {rate.shape[0]} clients; "
+                    f"this run has {self.num_clients} — the checkpoint "
+                    f"fingerprint should have rejected this resume")
+            part = np.asarray(state["participations"], np.int64)
+            comp = np.asarray(state["completions"], np.int64)
+            busy = np.asarray(state["busy_seconds"], np.float64)
+            seen = (rate > 0) | (part > 0) | (comp > 0) | (busy > 0)
+            ids = np.where(seen)[0].astype(np.int64)
+            state = {"rate": rate[seen], "participations": part[seen],
+                     "completions": comp[seen], "busy_seconds": busy[seen]}
+            rate = state["rate"]
+        self._n = len(ids)
+        self._ids = ids.copy()
+        self._slot = {int(c): i for i, c in enumerate(ids)}
+        self._rate = rate.copy()
+        self._participations = np.asarray(
             state["participations"], np.int64).copy()
-        self.completions = np.asarray(
+        self._completions = np.asarray(
             state["completions"], np.int64).copy()
-        self.busy_seconds = np.asarray(
+        self._busy = np.asarray(
             state["busy_seconds"], np.float64).copy()
+        self.total_participations = int(self._participations.sum())
+        self.total_completions = int(self._completions.sum())
+        self.version += 1

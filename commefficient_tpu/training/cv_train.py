@@ -176,8 +176,11 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
     if resumed_pos >= spe:
         train_loader.sampler.discard_pending()
         resumed_pos = 0
-    total_down = np.zeros(model.num_clients)
-    total_up = np.zeros(model.num_clients)
+    # byte totals are plain scalars: the accountant's per-round rows
+    # are COHORT-indexed since ISSUE 9 — a per-population accumulator
+    # here was an O(num_clients) host allocation per epoch
+    total_down = 0.0
+    total_up = 0.0
 
     writer = None
     if cfg.use_tensorboard and mh.is_coordinator():
@@ -205,8 +208,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
         skip_rounds = 0
         resumed_pos = 0
         losses, accs = [], []
-        down = np.zeros(model.num_clients)
-        up = np.zeros(model.num_clients)
+        down = 0.0
+        up = 0.0
 
         # EMNIST prints one line per STEP (reference cv_train.py:233-237)
         per_step_log = (cfg.dataset_name == "EMNIST"
@@ -265,8 +268,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
             def on_comm(d, u):
                 nonlocal down, up
-                down += d
-                up += u
+                down += float(np.sum(d))
+                up += float(np.sum(u))
 
             run_scanned_rounds(
                 model, stream(),
@@ -319,8 +322,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                     loss, acc, d, u = model((client_ids, data, mask))
                 warmed[0] = True
                 opt.step()
-                down += d
-                up += u
+                down += float(np.sum(d))
+                up += float(np.sum(u))
                 if pending is not None and not emit(pending):
                     pending = None
                     break
@@ -360,8 +363,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             "test_time": val_time,
             "test_loss": val_loss,
             "test_acc": val_acc,
-            "down (MiB)": float(total_down.sum() / (1024 ** 2)),
-            "up (MiB)": float(total_up.sum() / (1024 ** 2)),
+            "down (MiB)": float(total_down / (1024 ** 2)),
+            "up (MiB)": float(total_up / (1024 ** 2)),
             "total_time": timer.total_time,
         }
         for logger in loggers:
@@ -398,7 +401,8 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 fingerprint=model.checkpoint_fingerprint,
                 throughput=model.throughput.state_dict(),
                 scheduler=model.scheduler_state(),
-                sampler=model.sampler_state())
+                sampler=model.sampler_state(),
+                client_rows=model.client_rows_payload())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
                     "checkpoint", path=path,
@@ -580,7 +584,8 @@ def main(argv=None) -> bool:
                 fingerprint=model.checkpoint_fingerprint,
                 throughput=model.throughput.state_dict(),
                 scheduler=model.scheduler_state(),
-                sampler=model.sampler_state())
+                sampler=model.sampler_state(),
+                client_rows=model.client_rows_payload())
             if coord:
                 print(f"saved checkpoint to {path}")
     finally:

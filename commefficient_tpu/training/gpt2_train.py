@@ -346,7 +346,8 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 fingerprint=model.checkpoint_fingerprint,
                 throughput=model.throughput.state_dict(),
                 scheduler=model.scheduler_state(),
-                sampler=model.sampler_state())
+                sampler=model.sampler_state(),
+                client_rows=model.client_rows_payload())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
                     "checkpoint", path=written,
@@ -589,7 +590,8 @@ def main(argv=None) -> bool:
                            fingerprint=model.checkpoint_fingerprint,
                            throughput=model.throughput.state_dict(),
                            scheduler=model.scheduler_state(),
-                           sampler=model.sampler_state())
+                           sampler=model.sampler_state(),
+                           client_rows=model.client_rows_payload())
             # HF-style final artifact: tokenizer + config + weights
             # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
             if coord:

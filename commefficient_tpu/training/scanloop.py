@@ -144,7 +144,8 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
             fingerprint=model.checkpoint_fingerprint,
             throughput=model.throughput.state_dict(),
             scheduler=model.scheduler_state(),
-            sampler=model.sampler_state())
+            sampler=model.sampler_state(),
+            client_rows=model.client_rows_payload())
         tele = getattr(model, "telemetry", None)
         if tele is not None:
             # the save is a full state gather + disk write — exactly

@@ -105,6 +105,13 @@ class Checkpoint(NamedTuple):
     # (throughput-aware) mid-epoch resume replays the exact same data
     # stream instead of re-drawing the epoch head
     sampler: Optional[dict] = None
+    # O(cohort) client-state rows (ISSUE 9, `crows_*` keys): the
+    # touched-row ids, per-block rows for exactly those ids, and the
+    # init-weights base untouched topk_down rows reconstruct from —
+    # checkpoint bytes scale with clients-ever-sampled, not the
+    # population (FedModel.client_rows_payload / load_state). When
+    # present, `clients` above is None: the two formats are exclusive.
+    client_rows: Optional[dict] = None
 
 
 def save_checkpoint(path: str, server: ServerState,
@@ -117,7 +124,8 @@ def save_checkpoint(path: str, server: ServerState,
                     fingerprint: Optional[dict] = None,
                     throughput: Optional[dict] = None,
                     scheduler: Optional[dict] = None,
-                    sampler: Optional[dict] = None) -> str:
+                    sampler: Optional[dict] = None,
+                    client_rows: Optional[dict] = None) -> str:
     """Write training state to `path` (.npz appended if absent).
     Per-client state can be excluded (include_clients=False) to keep
     files small when clients are stateless (error_type != local and
@@ -148,7 +156,14 @@ def save_checkpoint(path: str, server: ServerState,
         "round_idx": mh.gather_host(server.round_idx),
         "scheduler_step": np.asarray(scheduler_step),
     }
-    if include_clients and clients is not None:
+    if include_clients and client_rows is not None:
+        # O(cohort) format (ISSUE 9): persist ONLY the touched rows
+        # (FedModel.client_rows_payload) — checkpoint bytes stay flat
+        # while the population grows. Takes precedence over the dense
+        # `clients` blocks; the loader reconstructs init + rows.
+        for k, v in client_rows.items():
+            arrays[f"crows_{k}"] = np.asarray(v)
+    elif include_clients and clients is not None:
         arrays["client_errors"] = _gather_rows(clients.errors, chunk_rows)
         arrays["client_velocities"] = _gather_rows(clients.velocities,
                                                    chunk_rows)
@@ -244,7 +259,11 @@ def load_checkpoint(path: str,
         round_idx=jnp.asarray(z["round_idx"]),
     )
     clients = None
-    if "client_errors" in z:
+    client_rows = None
+    if "crows_ids" in z.files:
+        client_rows = {k[len("crows_"):]: z[k] for k in z.files
+                       if k.startswith("crows_")}
+    elif "client_errors" in z:
         clients = ClientState(
             errors=jnp.asarray(z["client_errors"]),
             velocities=jnp.asarray(z["client_velocities"]),
@@ -262,7 +281,7 @@ def load_checkpoint(path: str,
            if k.startswith("smp_")}
     return Checkpoint(server, clients, int(z["scheduler_step"]),
                       acct or None, prev, fingerprint, thr or None,
-                      sched or None, smp or None)
+                      sched or None, smp or None, client_rows)
 
 
 # ---------------- keep-last-k rotation + latest manifest -----------------

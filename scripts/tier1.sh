@@ -110,5 +110,27 @@ if [ "$rc" -eq 0 ]; then
       || { echo "PALLAS_SMOKE_FAILED"; exit 1; }
   python scripts/journal_summary.py "$JR3" \
       || { echo "PALLAS_JOURNAL_INVALID"; exit 1; }
+
+  # large-population smoke (ISSUE 9 satellite): the O(active) refactor
+  # driven end-to-end at a 100k-client population with the --test tiny
+  # model (D=100) and local_topk + local error + momentum + topk_down,
+  # so all three sharded state blocks exist and the cohort
+  # gather/scatter, sparse accountant/tracker, and O(cohort)
+  # checkpointless round path all run against a population 10,000x the
+  # cohort. Same 8-device host mesh as the mesh-audit step; the
+  # journal must validate.
+  JR4=/tmp/_t1_journal_pop.jsonl
+  rm -f "$JR4"
+  timeout -k 10 500 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode local_topk \
+      --error_type local --local_momentum 0.9 --topk_down \
+      --num_clients 100000 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --journal_path "$JR4" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "POPULATION_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR4" \
+      || { echo "POPULATION_JOURNAL_INVALID"; exit 1; }
 fi
 exit $rc

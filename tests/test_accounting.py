@@ -37,9 +37,11 @@ def test_upload_bytes_per_mode():
         if mode == "local_topk":
             kw = dict(error_type="local")
         acct = CommAccountant(cfg_for(mode=mode, k=5, **kw), num_clients=10)
+        # COHORT-indexed returns (ISSUE 9): up[i] is the charge of
+        # participating[i], not of client id i
         _, up = acct.record_round(np.array([1, 3]), None)
-        assert up[1] == up[3] == 4.0 * floats
-        assert up[0] == 0
+        assert up.shape == (2,)
+        assert up[0] == up[1] == 4.0 * floats
     acct = CommAccountant(
         cfg_for(mode="sketch", num_rows=3, num_cols=7,
                 error_type="virtual", local_momentum=0.0),
@@ -59,8 +61,7 @@ def test_upload_bytes_reflect_wire_dtype():
         acct = CommAccountant(
             cfg_for(sketch_table_dtype=dtype, **base), num_clients=10)
         _, up = acct.record_round(np.array([0, 4]), None)
-        assert up[0] == up[4] == want, (dtype, up[0], want)
-        assert up[1] == 0
+        assert up[0] == up[1] == want, (dtype, up[0], want)
     # downloads are dense f32 weights regardless of the table dtype:
     # round 2's download charge is unchanged by quantized uploads
     acct = CommAccountant(
@@ -86,16 +87,16 @@ def test_download_counts_changed_coords():
     change1 = np.asarray(pack_change_bits(
         jnp.zeros(64).at[jnp.array([1, 2, 3])].set(1.0)))
     # round 2: client 0 re-participates (stale 1 round -> 3 coords),
-    # client 2 joined at init and is stale 1 round too
+    # client 2 joined at init and is stale 1 round too (cohort slots)
     down, _ = acct.record_round(np.array([0, 2]), change1)
     assert down[0] == 4.0 * 3
-    assert down[2] == 4.0 * 3
+    assert down[1] == 4.0 * 3
     # round 3: client 1 last participated in round 1 -> union of
     # rounds 2-3 changes
     change2 = np.asarray(pack_change_bits(
         jnp.zeros(64).at[jnp.array([3, 10])].set(1.0)))
     down, _ = acct.record_round(np.array([1]), change2)
-    assert down[1] == 4.0 * 4  # {1,2,3} | {3,10} = 4 coords
+    assert down[0] == 4.0 * 4  # {1,2,3} | {3,10} = 4 coords
 
 
 def test_cheap_path_accumulates_since_init():
@@ -106,10 +107,10 @@ def test_cheap_path_accumulates_since_init():
     acct.record_round(np.array([0]), None)
     c1 = np.asarray(pack_change_bits(jnp.zeros(64).at[0].set(1.0)))
     down, _ = acct.record_round(np.array([1]), c1)
-    assert down[1] == 4.0
+    assert down[0] == 4.0
     c2 = np.asarray(pack_change_bits(jnp.zeros(64).at[5].set(1.0)))
     down, _ = acct.record_round(np.array([2]), c2)
-    assert down[2] == 8.0  # coords {0, 5} changed since init
+    assert down[0] == 8.0  # coords {0, 5} changed since init
 
 
 def test_staleness_clamped_to_deque():
@@ -156,7 +157,7 @@ def test_accountant_state_roundtrip():
     down_a, _ = a.record_round(np.array([1]), c2)
     down_b, _ = b.record_round(np.array([1]), c2)
     np.testing.assert_allclose(down_b, down_a)
-    assert down_a[1] == 4.0 * 5  # {1,2} | {3,10,11}
+    assert down_a[0] == 4.0 * 5  # {1,2} | {3,10,11}
 
     # cheap path too
     cheap_cfg = cfg_for(num_epochs=1.0, local_batch_size=-1,

@@ -88,16 +88,30 @@ def test_population_inventory_names_the_client_state(full_audit):
     list: all three dense per-client blocks, named, with population-
     scaled shapes, on both the input and carried-output side."""
     report, _ = full_audit
-    inv = report["programs"]["client-state/dropout_stragglers"][
+    # ISSUE 9: the ROUND programs are population-free — empty
+    # inventory on the jitted-round side for every audit config (the
+    # refactor's mechanical definition of done)
+    for cfg_name in ("client-state", "sketch-xla", "sketch-pallas"):
+        for variant in ("mask_free", "dropout", "dropout_stragglers"):
+            inv = report["programs"][f"{cfg_name}/{variant}"][
+                "population_inventory"]
+            assert inv["inputs"] == [] and inv["outputs"] == [], (
+                cfg_name, variant)
+    # the named client-state map now lives on the two state-motion
+    # programs: gather reads all three dense blocks, scatter carries
+    # them in AND out
+    names = {"clients.errors", "clients.velocities", "clients.weights"}
+    g = report["programs"]["client-state/gather"][
         "population_inventory"]
-    in_names = {e["name"] for e in inv["inputs"]}
-    assert in_names == {"clients.errors", "clients.velocities",
-                        "clients.weights"}
-    for e in inv["inputs"] + inv["outputs"]:
+    assert {e["name"] for e in g["inputs"]} == names
+    s = report["programs"]["client-state/scatter"][
+        "population_inventory"]
+    assert {e["name"] for e in s["inputs"]} == names
+    assert {e["name"] for e in s["outputs"]} == names
+    for e in g["inputs"] + s["inputs"] + s["outputs"]:
         assert e["shape"][0] == A.AUDIT_POPULATION
-    assert len(inv["outputs"]) == 3
-    # the cohort-sized sketch configs carry NO population state at all
-    sk = report["programs"]["sketch-xla/mask_free"][
+    # the stateless sketch configs' state-motion programs move nothing
+    sk = report["programs"]["sketch-xla/gather"][
         "population_inventory"]
     assert sk["inputs"] == [] and sk["outputs"] == []
 
@@ -217,9 +231,12 @@ def test_au005_undonated_dead_inputs_fire():
         cfg.replace(donate_round_state=False))
     findings = A.donation_findings("sketch-xla", handle)
     assert {v.rule for v in findings} == {"AU005"}
-    # per-round clients + scanned server + scanned clients
-    assert len(findings) == len(ROUND_DEAD_ARGNUMS) + len(
-        SPAN_DEAD_ARGNUMS)
+    # per-round cohort + scatter-back clients + scanned server +
+    # scanned clients
+    from commefficient_tpu.federated.round import SCATTER_DEAD_ARGNUMS
+    assert len(findings) == (len(ROUND_DEAD_ARGNUMS)
+                             + len(SCATTER_DEAD_ARGNUMS)
+                             + len(SPAN_DEAD_ARGNUMS))
     # with donation wired (the default) the same config is clean
     handle_on, *_ = A.build_workload(cfg)
     assert A.donation_findings("sketch-xla", handle_on) == []
@@ -339,8 +356,11 @@ def _mini(mesh, donate: bool, num_clients: int = 16):
                  microbatch_size=-1, num_clients=num_clients,
                  donate_round_state=donate).validate()
     handle = make_train_fn(_loss_fn, unravel, cfg, mesh)
-    server = init_server_state(cfg, vec)
-    clients = init_client_state(cfg, num_clients, vec)
+    server = init_server_state(cfg, vec, mesh=mesh)
+    # mesh-placed, the production pattern: the scatter-back jit pins
+    # P('clients', None) out_shardings, and donation only aliases when
+    # the input already lives in that layout
+    clients = init_client_state(cfg, num_clients, vec, mesh=mesh)
     rng = np.random.RandomState(7)
     x = jnp.asarray(rng.randn(8, 4, D).astype(np.float32))
     y = jnp.asarray(rng.randn(8, 4).astype(np.float32))
@@ -381,12 +401,24 @@ def test_donation_resume_bit_exact(mesh):
     h, s, c, b = _mini(mesh, donate=True)
     s_straight, c_straight = _run(h, s, c, b, 6, key)
 
+    from jax.sharding import PartitionSpec as P
+
+    from commefficient_tpu.federated.round import client_state_specs
+    from commefficient_tpu.parallel import multihost as mh
+
     h2, s2, c2, b2 = _mini(mesh, donate=True)
     s2, c2 = _run(h2, s2, c2, b2, 3, key)
     saved_server = [np.asarray(f) for f in s2]
     saved_clients = [np.asarray(f) for f in c2]
-    s3 = type(s2)(*[jnp.asarray(f) for f in saved_server])
-    c3 = type(c2)(*[jnp.asarray(f) for f in saved_clients])
+    # restore with the PRODUCTION placement (FedModel.load_state:
+    # globalize onto the mesh under the CLIENT_STATE_RULES specs) —
+    # a default-placed restore would silently defeat the scatter-back
+    # donation aliasing
+    s3 = type(s2)(*[mh.globalize(mesh, P(), f) for f in saved_server])
+    c3 = type(c2)(*[mh.globalize(mesh, spec, f)
+                    for f, spec in zip(saved_clients,
+                                       client_state_specs(
+                                           type(c2)(*saved_clients)))])
     s3, c3 = _run(h2, s3, c3, b2, 3, key)
     assert _state_bytes(s_straight) == _state_bytes(s3)
     assert _state_bytes(c_straight) == _state_bytes(c3)
@@ -427,6 +459,10 @@ def test_donated_dispatch_three_programs_and_no_transfers(
     lr = mh.globalize(mesh, P(), np.float32(0.1))
     key = mh.globalize(mesh, P(), jax.random.PRNGKey(0))
 
+    with sanitize.assert_program_count(2):
+        # the state-motion pair compiles once (shared by all variants)
+        cohort = h.gather(clients, ids)
+        clients = h.scatter(clients, ids, cohort)
     with sanitize.assert_program_count(3):
         for b in batches * 2:  # second sweep: all cache hits
             server, clients, _ = h(server, clients, b, lr, key)

@@ -212,8 +212,8 @@ def test_accounting_excludes_dropped_clients():
     live = [c for c in range(8) if c != 3]
     assert np.all(up1[live] > 0)
     # staleness: everyone else reset to 1 after the round, client 3 at 2
-    assert model.accountant.stale[3] == 2
-    assert np.all(model.accountant.stale[live] == 1)
+    assert model.accountant.staleness([3])[0] == 2
+    assert np.all(model.accountant.staleness(live) == 1)
 
     # client 3's next completed round downloads BOTH missed rounds'
     # changes (>= any single-round download of this round)

@@ -346,6 +346,11 @@ def test_pallas_round_exactly_three_programs(mesh, sanitize):
                       donate_round_state=False)
     train_round, server, clients = _round_setup(mesh, cfg, place=True)
     b0, b1, b2, lr, key = _placed_batches(mesh)
+    with sanitize.assert_program_count(2):
+        # the state-motion pair (cohort gather / scatter-back, shared
+        # by all three variants) compiles once — ISSUE 9 split
+        cohort = train_round.gather(clients, b0.client_ids)
+        train_round.scatter(clients, b0.client_ids, cohort)
     with sanitize.assert_program_count(3):
         for b in (b0, b1, b2):
             train_round(server, clients, b, lr, key)

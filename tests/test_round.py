@@ -323,11 +323,21 @@ def test_exactly_three_round_programs(mesh, sanitize):
     """ROADMAP's 'exactly three traced round programs' prose as an
     executed check (analysis/runtime.assert_program_count): the
     mask-free, dropout, and dropout+straggler configurations compile
-    one program each — and NOTHING else. A fourth program here is an
-    accidental retrace (new treedef/shape/weak-type leak), the exact
-    regression class the straggler work landed without."""
+    one ROUND program each — and NOTHING else. A fourth program here
+    is an accidental retrace (new treedef/shape/weak-type leak), the
+    exact regression class the straggler work landed without.
+
+    Since the ISSUE 9 state-motion split the cohort-gather and
+    scatter-back compile as exactly TWO additional programs, once per
+    config — pinned in their own block below so every later dispatch
+    (all three variants share one gather and one scatter treedef) is a
+    cache hit and the three-round-programs claim stays exact."""
     train_round, server, clients, batches, lr, key = (
         _sanitized_round_setup(mesh))
+    ids = batches[0].client_ids
+    with sanitize.assert_program_count(2):
+        cohort = train_round.gather(clients, ids)
+        train_round.scatter(clients, ids, cohort)
     with sanitize.assert_program_count(3):
         for b in batches:
             train_round(server, clients, b, lr, key)

@@ -203,14 +203,14 @@ def test_scheduler_adds_no_device_programs(sanitize):
         for sweep in range(2):
             # (a) no measurements yet -> no deadline, idle slots only
             # (target 2 of 8 slots) -> the DROPOUT program
-            model.throughput.rate[:] = 0.0
+            model.throughput.force(np.arange(12), rate=np.zeros(12))
             active = drive(1 + 10 * sweep)
             assert active.sum() == 2
             # (b) measured with distinct rates -> any cohort's 0.9-
             # quantile deadline truncates its slowest member -> the
             # DROPOUT+STRAGGLER (work) program
-            model.throughput.rate[:] = np.linspace(
-                2.0, 8.0, 12).astype(np.float32)
+            model.throughput.force(np.arange(12), rate=np.linspace(
+                2.0, 8.0, 12).astype(np.float32))
             drive(2 + 10 * sweep)
             assert sched.truncated_slots > 0
 
@@ -227,9 +227,9 @@ def test_scheduled_scanned_span_transfer_guard_clean(sanitize):
     model.attach_scheduler(sched)
     rates = np.full(12, 8.0, np.float32)
     rates[:3] = 0.5
-    model.throughput.rate[:] = rates
-    model.throughput.completions[:] = 3
-    model.throughput.participations[:] = 3
+    model.throughput.force(np.arange(12), rate=rates,
+                           completions=np.full(12, 3),
+                           participations=np.full(12, 3))
     pool = _client_pool(12)
     rng = np.random.RandomState(1)
 
@@ -278,8 +278,7 @@ def test_throughput_sampler_fairness_floor():
     tracker = ClientThroughputTracker(N)
     rates = np.full(N, 10.0, np.float32)
     rates[:4] = 0.5                     # chronically slow clients
-    tracker.rate[:] = rates
-    tracker.completions[:] = 1
+    tracker.force(np.arange(N), rate=rates, completions=np.ones(N))
     sampler = ThroughputAwareSampler(0, tracker, explore_floor=floor)
     counts = np.zeros(N)
     R = 3000
@@ -301,7 +300,8 @@ def test_throughput_sampler_unmeasured_neutral_prior():
     """Unmeasured clients take the MEDIAN measured rate: they are
     neither starved (slowest) nor flooded (fastest)."""
     tracker = ClientThroughputTracker(3)
-    tracker.rate[:] = [2.0, 8.0, 0.0]   # client 2 unmeasured
+    tracker.force(np.arange(3),
+                  rate=[2.0, 8.0, 0.0])  # client 2 unmeasured
     s = ThroughputAwareSampler(0, tracker, explore_floor=0.0)
     p = s.weights(np.arange(3))
     assert p[0] < p[2] < p[1]
@@ -327,7 +327,8 @@ def test_overprovision_math():
 
 def test_deadline_policy_quantile_and_floors():
     tracker = ClientThroughputTracker(8)
-    tracker.rate[:] = [1.0, 2.0, 4.0, 8.0, 8.0, 8.0, 8.0, 0.0]
+    tracker.force(np.arange(8),
+                  rate=[1.0, 2.0, 4.0, 8.0, 8.0, 8.0, 8.0, 0.0])
     pol = DeadlinePolicy(tracker, quantile=0.5, min_work=0.25)
     ids = np.arange(8)
     ex = np.full(8, 8.0)
@@ -372,15 +373,15 @@ def test_tracker_excludes_idle_pads():
                     scheduled=np.array([1.0, 1.0, 1.0, 0.0]))
     # slot 3 was a pad: no participation; slot 2 was a genuine
     # zero-example (dropped) participant: participation, no completion
-    assert list(tr.participations[:4]) == [1, 1, 1, 0]
-    assert list(tr.completions[:4]) == [1, 1, 0, 0]
+    assert list(tr.participation_counts(range(4))) == [1, 1, 1, 0]
+    assert list(tr.completion_counts(range(4))) == [1, 1, 0, 0]
     # survivors mask composes with the scheduled filter
     tr.update_round([0, 1, 2, 3], [4.0, 4.0, 4.0, 4.0],
                     round_seconds=1.0,
                     survivors=np.array([0.0, 1.0, 1.0, 1.0]),
                     scheduled=np.array([1.0, 1.0, 1.0, 0.0]))
-    assert list(tr.participations[:4]) == [2, 2, 2, 0]
-    assert list(tr.completions[:4]) == [1, 2, 1, 0]
+    assert list(tr.participation_counts(range(4))) == [2, 2, 2, 0]
+    assert list(tr.completion_counts(range(4))) == [1, 2, 1, 0]
 
 
 def test_tracker_cold_start_estimates():
@@ -452,13 +453,22 @@ def test_adaptation_slow_clients_measured_and_deprioritized(tmp_path):
     round's truncated processed-example counts) and the throughput
     policy + deadline measurably reduce estimated round time vs
     uniform sampling — asserted via the journaled schedule events."""
-    model_u, sched_u = _run_profiled(tmp_path, "uniform", "uni")
-    model_t, sched_t = _run_profiled(tmp_path, "throughput", "thr")
+    # 60 rounds with a 36-round steady window: the comparison is a
+    # mean over a stochastic slow-cohort indicator (~12% of throughput
+    # rounds draw a slow member), and the original 30/12 window put
+    # the deterministic draw stream within ~2 sigma of the margin —
+    # the alias-path stream (ISSUE 9) landed on the wrong side of the
+    # exact-choice stream's luck. The wider window tests the same
+    # claim with the noise averaged down.
+    model_u, sched_u = _run_profiled(tmp_path, "uniform", "uni",
+                                     rounds=60)
+    model_t, sched_t = _run_profiled(tmp_path, "throughput", "thr",
+                                     rounds=60)
 
     # the slow clients were measured: their EMA is a fraction of the
     # fast clients' (0.25 work -> 1 example/round vs 4)
     for model in (model_u, model_t):
-        rate = model.throughput.rate
+        rate = model.throughput.examples_per_sec()
         measured_slow = rate[:3][rate[:3] > 0]
         assert measured_slow.size, "no slow client ever measured"
         assert measured_slow.max() < 0.5 * rate[3:][rate[3:] > 0].min()
@@ -468,7 +478,7 @@ def test_adaptation_slow_clients_measured_and_deprioritized(tmp_path):
     assert any(s.get("truncated_slots", 0) > 0 for s in sched_u)
 
     def steady_est(events):
-        vals = [s["est_round_s"] for s in events[-12:]
+        vals = [s["est_round_s"] for s in events[-36:]
                 if s.get("est_round_s") is not None]
         assert vals, "no estimated round times journaled"
         return float(np.mean(vals))
@@ -478,7 +488,8 @@ def test_adaptation_slow_clients_measured_and_deprioritized(tmp_path):
     assert steady_est(sched_t) < 0.6 * steady_est(sched_u), (
         steady_est(sched_t), steady_est(sched_u))
     # and the slow clients are deprioritized but NOT starved (floor)
-    part = model_t.throughput.participations
+    part = model_t.throughput.participation_counts(
+        np.arange(model_t.num_clients))
     assert part[:3].sum() > 0
     assert part[3:].mean() > part[:3].mean()
 
@@ -577,8 +588,8 @@ def test_skip_replay_does_not_recount_scheduler_counters():
     cfg = _cfg(sampler="throughput", deadline_quantile=0.8,
                num_clients=12, num_workers=4)
     tracker = ClientThroughputTracker(12)
-    tracker.rate[:] = np.linspace(1.0, 4.0, 12)
-    tracker.completions[:] = 1
+    tracker.force(np.arange(12), rate=np.linspace(1.0, 4.0, 12),
+                  completions=np.ones(12))
 
     def commit(sched, r0, n):
         rng = np.random.RandomState(3)
@@ -657,8 +668,8 @@ def test_idle_slots_are_bit_exact_dropout(ckpt_dir):
         np.asarray(model_s.server.ps_weights),
         np.asarray(model_r.server.ps_weights))
     # accounting charged the pad clients nothing, identically to the
-    # scripted-drop reference ([-1] is the per-client upload vector)
-    pad_ids = slot_ids[active == 0]
+    # scripted-drop reference ([-1] is the COHORT-indexed upload
+    # vector since ISSUE 9: slot i charges participant i)
     np.testing.assert_array_equal(out_s[-1], out_r[-1])
-    assert (np.asarray(out_s[-1])[pad_ids] == 0).all()
+    assert (np.asarray(out_s[-1])[active == 0] == 0).all()
     assert float(np.asarray(model_s.server.round_idx)) == 1.0

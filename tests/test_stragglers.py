@@ -339,7 +339,7 @@ def test_cutoff_degradation_charges_nothing():
     assert up1[3] == 0.0 and down1[3] == 0.0
     live = [c for c in range(8) if c != 3]
     assert np.all(up1[live] > 0)
-    assert model.accountant.stale[3] == 2
+    assert model.accountant.staleness([3])[0] == 2
 
 
 # ---------------- scanned parity + crash -> resume -----------------------

@@ -460,16 +460,20 @@ def test_tracker_ema_and_estimates():
     tr = ClientThroughputTracker(6, ema_decay=0.5)
     # first completed round seeds the EMA with the raw sample
     tr.update_round([0, 1, 2], [10.0, 20.0, 0.0], round_seconds=2.0)
-    np.testing.assert_allclose(tr.rate[[0, 1]], [5.0, 10.0])
-    assert tr.rate[2] == 0.0  # zero examples: participation only
-    assert list(tr.participations[:3]) == [1, 1, 1]
-    assert list(tr.completions[:3]) == [1, 1, 0]
+    np.testing.assert_allclose(tr.examples_per_sec([0, 1]),
+                               [5.0, 10.0])
+    # zero examples: participation only
+    assert tr.examples_per_sec([2])[0] == 0.0
+    assert list(tr.participation_counts(range(3))) == [1, 1, 1]
+    assert list(tr.completion_counts(range(3))) == [1, 1, 0]
     # second observation folds in at decay 0.5
     tr.update_round([0], [30.0], round_seconds=2.0)
-    np.testing.assert_allclose(tr.rate[0], 0.5 * 5.0 + 0.5 * 15.0)
+    np.testing.assert_allclose(tr.examples_per_sec([0])[0],
+                               0.5 * 5.0 + 0.5 * 15.0)
     # deadline estimation: unmeasured clients estimate to +inf
     est = tr.estimate_round_seconds([0, 5], [100.0, 100.0])
-    np.testing.assert_allclose(est[0], 100.0 / tr.rate[0])
+    np.testing.assert_allclose(est[0],
+                               100.0 / tr.examples_per_sec([0])[0])
     assert np.isinf(est[1])
     # no timing signal -> no state movement
     before = tr.state_dict()
@@ -539,9 +543,20 @@ def test_crash_resume_preserves_tracker_ema(ckpt_dir, tmp_path):
 
 def test_tracker_rejects_wrong_population():
     tr = ClientThroughputTracker(4)
+    # sparse rows: a capture naming a client id beyond this run's
+    # population is the incompatibility signal (an EMPTY capture is
+    # population-agnostic by design — nothing was ever seen)
     other = ClientThroughputTracker(8)
+    other.force([7], rate=[1.0])
     with pytest.raises(ValueError):
         tr.load_state_dict(other.state_dict())
+    # legacy dense captures still carry the population in their shape
+    legacy = {"rate": np.zeros(8, np.float32),
+              "participations": np.zeros(8, np.int64),
+              "completions": np.zeros(8, np.int64),
+              "busy_seconds": np.zeros(8, np.float64)}
+    with pytest.raises(ValueError):
+        tr.load_state_dict(legacy)
 
 
 # ---------------- satellite units ------------------------------------------
