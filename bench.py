@@ -648,6 +648,143 @@ def population_main() -> int:
     return 0
 
 
+def pipeline_main() -> int:
+    """ISSUE 10 pipeline sweep: round-cadence histogram, synchronous
+    vs pipelined, measured on the REAL scanned staging loop
+    (training/scanloop.run_scanned_rounds + FedModel) with the full
+    persistence load armed — per-span journal fsyncs and per-span
+    rotated checkpoints — because that host work is exactly what the
+    pipeline moves off the critical path.
+
+    Both arms drive the identical synthetic stream (scan_span=1, so
+    every round is a span boundary = worst-case persistence cadence);
+    the histogram is computed from the JOURNAL's own round events
+    (consecutive `ts` diffs — the artifact a production cadence
+    investigation would read), warmup spans dropped. Reported:
+    p50/p95 inter-round seconds per arm and `vs_sync` = pipelined p50
+    / sync p50 (< 1.0 = the pipeline shortened the critical path).
+    In-process and CPU-friendly; invoked via BENCH_PIPELINE=1 or
+    `python bench.py --pipeline`. Lands in BENCH_r10.json."""
+    import tempfile
+
+    import numpy as np
+
+    with alarm_guard(INIT_TIMEOUT, "backend init"):
+        import jax
+        import jax.numpy as jnp
+        platform = jax.devices()[0].platform
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.federated.api import FedModel, FedOptimizer
+    from commefficient_tpu.telemetry import TelemetrySession
+    from commefficient_tpu.telemetry.journal import (
+        RunJournal, read_journal, validate_journal,
+    )
+    from commefficient_tpu.training.scanloop import (
+        make_span_checkpoint, run_scanned_rounds,
+    )
+    from commefficient_tpu.utils.schedules import LambdaLR
+
+    Dp = int(os.environ.get("BENCH_PIPELINE_D", "65536"))
+    Wp, Bp = 8, 32
+    ROUNDS_P = int(os.environ.get("BENCH_PIPELINE_ROUNDS", "40"))
+    WARMUP = 8
+    log(f"pipeline cadence sweep on {platform} "
+        f"(D={Dp}, {ROUNDS_P} rounds, span=1)")
+
+    def loss_fn(params, batch, mask):
+        x, y = batch
+        pred = x @ params["w"]
+        per_ex = 0.5 * (pred - y) ** 2
+        loss = (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, (loss,)
+
+    # lr small enough that the repeated-batch regression stays finite
+    # over the whole sweep: the bit-identity check below compares the
+    # final weights, and NaN != NaN would mask a real divergence
+    LR = 1e-4
+    rng = np.random.RandomState(0)
+    x = rng.randn(Wp, Bp, Dp).astype(np.float32)
+    y = rng.randn(Wp, Bp).astype(np.float32)
+    ids = np.arange(Wp, dtype=np.int32)
+    mask = np.ones((Wp, Bp), np.float32)
+    stream = [(r, ids, (x, y), mask, LR) for r in range(ROUNDS_P)]
+
+    def run_arm(pipeline: bool, workdir: str) -> dict:
+        cfg = Config(
+            mode="uncompressed", error_type="none", local_momentum=0.0,
+            virtual_momentum=0.9, grad_size=Dp, weight_decay=0.0,
+            num_workers=Wp, microbatch_size=-1, num_clients=Wp,
+            checkpoint_every=1, ckpt_every_spans=1, keep_checkpoints=2,
+            pipeline=pipeline, seed=0).validate()
+        model = FedModel(None, loss_fn, cfg,
+                         params={"w": jnp.zeros(Dp, jnp.float32)})
+        opt = FedOptimizer(model)
+        opt.param_groups[0]["lr"] = LR
+        sch = LambdaLR(opt, lr_lambda=lambda s: 1.0)
+        jpath = os.path.join(workdir, "journal.jsonl")
+        tele = TelemetrySession(journal=RunJournal(
+            jpath, run_id="bench", async_writer=pipeline))
+        model.attach_telemetry(tele)
+        hook = make_span_checkpoint(
+            os.path.join(workdir, "ck"), model, cfg, sch)
+        with alarm_guard(STAGE_TIMEOUT,
+                         f"pipeline={pipeline} rounds"):
+            t0 = time.perf_counter()
+            ok = run_scanned_rounds(model, iter(stream), 1,
+                                    lambda *a: True, checkpoint=hook,
+                                    pipeline=pipeline)
+            assert ok
+            wall = time.perf_counter() - t0
+        model.close_persistence()
+        tele.close(ok=True)
+        recs, problems = validate_journal(jpath)
+        assert not problems, problems
+        ts = [r["ts"] for r in recs if r.get("event") == "round"]
+        gaps = np.diff(np.asarray(ts, np.float64))[WARMUP:]
+        weights = np.asarray(model.server.ps_weights)
+        assert np.all(np.isfinite(weights)), \
+            "bench workload diverged — lower LR"
+        return {
+            "p50_inter_round_s": round(float(np.percentile(gaps, 50)),
+                                       6),
+            "p95_inter_round_s": round(float(np.percentile(gaps, 95)),
+                                       6),
+            "rounds": len(ts),
+            "wall_s": round(wall, 3),
+            "final_weights": weights,
+        }
+
+    with tempfile.TemporaryDirectory() as td_s, \
+            tempfile.TemporaryDirectory() as td_p:
+        sync = run_arm(False, td_s)
+        pipe = run_arm(True, td_p)
+
+    # the two arms ran the identical stream: their final state must
+    # agree bit-for-bit (the overlap reorders host work only)
+    bit_identical = bool(np.array_equal(sync.pop("final_weights"),
+                                        pipe.pop("final_weights")))
+    vs_sync = (pipe["p50_inter_round_s"] / sync["p50_inter_round_s"]
+               if sync["p50_inter_round_s"] > 0 else None)
+    out = {
+        "metric": "pipelined_round_cadence",
+        "value": pipe["p50_inter_round_s"],
+        "unit": "s/round (p50 inter-round, journal round events)",
+        "vs_baseline": None,
+        "vs_sync": None if vs_sync is None else round(vs_sync, 4),
+        "platform": platform,
+        "geometry": {"D": Dp, "num_workers": Wp, "local_batch": Bp,
+                     "rounds": ROUNDS_P, "scan_span": 1,
+                     "ckpt_every_spans": 1, "mode": "uncompressed"},
+        "sync": sync,
+        "pipelined": pipe,
+        "bit_identical": bit_identical,
+    }
+    journal_digest(out, "bench_digest")
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _run_child(extra_env, timeout_s, script=None):
     """Run the measurement in a child process; returns the parsed JSON
     line or None. A hard kill-on-timeout is the only watchdog that
@@ -866,6 +1003,11 @@ def orchestrate() -> int:
 
 
 if __name__ == "__main__":
+    if (os.environ.get("BENCH_PIPELINE") == "1"
+            or "--pipeline" in sys.argv):
+        # ISSUE 10 pipeline cadence sweep: in-process (CPU-friendly);
+        # sync vs pipelined round cadence from journal round events
+        raise SystemExit(worker_entry(pipeline_main))
     if (os.environ.get("BENCH_POPULATION") == "1"
             or "--population" in sys.argv):
         # ISSUE 9 population sweep: in-process (tiny D, CPU-friendly);

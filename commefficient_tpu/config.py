@@ -314,6 +314,48 @@ class Config:
     # slot, the pre-scheduler behavior.
     target_survivors: int = 0
 
+    # pipelined round engine (ISSUE 10). OFF by default — the default
+    # path is bit-identical to the pre-feature synchronous loop (the
+    # pipelining machinery is never constructed). When on:
+    #   * the scanned staging loop double-buffers dispatch
+    #     (training/scanloop.py): span t+1's host staging — sampler
+    #     draws, batch stacking, fault operands, explicit device
+    #     placement — overlaps span t's device execution, and the
+    #     span's accounting/journal/checkpoint commit one span late
+    #     (FedModel.dispatch_rounds / collect_rounds);
+    #   * journal appends and span-boundary checkpoint serialization
+    #     move onto bounded-queue writer threads
+    #     (telemetry/journal.RunJournal(async_writer=True),
+    #     utils/checkpoint.AsyncCheckpointWriter) with flush-on-close
+    #     and drain-at-crash — atomic-rename and torn-tail semantics
+    #     unchanged;
+    #   * the scanned span jit does NOT donate its state operands
+    #     (round.py): the span-boundary checkpoint persists span t's
+    #     state while span t+1 — which would otherwise consume those
+    #     buffers in place — is already in flight, so double buffering
+    #     transiently doubles state HBM (the price of the overlap).
+    # Single-controller only for now (the writer threads and the
+    # deferred commit would need cross-process barriers).
+    pipeline: bool = False
+    # buffered async aggregation (ISSUE 10): admit a straggler's late
+    # contribution into round t+k instead of truncating it at round
+    # t's deadline. A sampled client whose work fraction is below 1.0
+    # (random straggler draw, FaultSchedule.slow, or a deadline
+    # truncation) is DEFERRED: excluded from round t exactly like a
+    # dropped client (no upload, state rows bit-untouched, accounting
+    # charges nothing), then merged into round t+k's cohort operands
+    # with its work fraction discounted by async_staleness_decay**k —
+    # the FedNova-style processed-example reweighting the work operand
+    # already implements turns that into a staleness-discounted
+    # aggregation weight. Zero new traced programs: admission reuses
+    # the existing dropout/straggler operand treedefs
+    # (federated/async_agg.py). 0 = off (the synchronous straggler
+    # path); k=0 via the buffer API is proven bit-identical to it.
+    async_admit_rounds: int = 0
+    # per-round staleness decay of a late-admitted contribution's
+    # work fraction: weight = decay**rounds_late (1.0 = no discount)
+    async_staleness_decay: float = 0.5
+
     # set after model construction (reference mutates args.grad_size at
     # fed_aggregator.py:88; we return a new frozen Config instead)
     grad_size: int = 0
@@ -537,6 +579,23 @@ class Config:
                 "process-local wall-clock throughput measurements and "
                 "would diverge across controllers (coordinator-"
                 "broadcast scheduling is the named ROADMAP opening)")
+        if self.async_admit_rounds < 0:
+            raise ValueError(
+                "async_admit_rounds must be >= 0 (0 = synchronous "
+                "stragglers, k = admit late contributions k rounds on)")
+        if not 0.0 < self.async_staleness_decay <= 1.0:
+            raise ValueError(
+                f"async_staleness_decay={self.async_staleness_decay} "
+                "must be in (0, 1] (1.0 = undiscounted late admission)")
+        if self.multihost and (self.pipeline
+                               or self.async_admit_rounds > 0):
+            raise ValueError(
+                "--pipeline / --async_admit_rounds are single-"
+                "controller only for now: the persistence writer "
+                "threads and the one-span-late commit would need "
+                "cross-process barriers, and the admit buffer holds "
+                "process-local batch rows (coordinator-broadcast "
+                "scheduling is the named ROADMAP opening)")
         if self.kernel_backend not in ("xla", "pallas"):
             raise ValueError(
                 f"unknown kernel_backend {self.kernel_backend!r} "
@@ -679,6 +738,26 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                         "save is a full state gather — raise k to "
                         "bound the save rate; 0 = epoch cadence only)")
 
+    p.add_argument("--pipeline", action="store_true",
+                   help="pipelined round engine: double-buffered "
+                        "scanned dispatch (span t+1 stages while span "
+                        "t runs on device) + journal/checkpoint "
+                        "persistence on bounded-queue writer threads. "
+                        "OFF by default — the default loop is bit-"
+                        "identical to the pre-feature program "
+                        "(Config.pipeline)")
+    p.add_argument("--async_admit_rounds", type=int, default=0,
+                   help="buffered async aggregation: defer a "
+                        "straggler's contribution out of its round "
+                        "(bit-exactly the dropped-client path) and "
+                        "admit it k rounds later with a staleness-"
+                        "discounted work fraction on the existing "
+                        "straggler operand (0 = synchronous; "
+                        "Config.async_admit_rounds)")
+    p.add_argument("--async_staleness_decay", type=float, default=0.5,
+                   help="per-round decay of a late-admitted "
+                        "contribution's work fraction: weight = "
+                        "decay**rounds_late (1.0 = undiscounted)")
     p.add_argument("--sampler", choices=("uniform", "throughput"),
                    default="uniform",
                    help="participant-sampling policy: uniform (bit-"

@@ -33,7 +33,7 @@ the mask is the price of static shapes.
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +44,7 @@ from commefficient_tpu.federated import round as fround
 from commefficient_tpu.federated.accounting import (
     CommAccountant, pack_change_bits,
 )
+from commefficient_tpu.federated.async_agg import AsyncAdmitBuffer
 from commefficient_tpu.ops.flat import flatten_params
 from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
@@ -52,7 +53,38 @@ from commefficient_tpu.utils.faults import (
     FaultSchedule, InjectedFault, bernoulli_survivors,
     straggler_work_fractions,
 )
-from commefficient_tpu.utils.retry import with_retries
+from commefficient_tpu.utils.retry import is_transient_error, with_retries
+
+
+class _StagedRound(NamedTuple):
+    """One round's host-prepared dispatch operands (FedModel.
+    stage_round): the batch leaves already explicitly placed on the
+    mesh, plus the host-side copies commit_staged's accounting and
+    telemetry consume. Staging may run one round AHEAD of the commit
+    (the pipelined prefetch) because nothing in it reads round
+    state — fault draws are pure functions of (seed, round index)."""
+    round_idx: int
+    batch: "fround.RoundBatch"        # operands placed on the mesh
+    lr: jax.Array
+    client_ids: np.ndarray            # host copy, post-admission
+    survivors: Optional[np.ndarray]   # host copy (accounting)
+
+
+class _SpanHandle(NamedTuple):
+    """One dispatched-but-uncollected scanned span (FedModel.
+    dispatch_rounds -> collect_rounds). `metrics`/`bits` are the span
+    program's output futures; the host copies carry what the deferred
+    accounting/telemetry commit needs. Collect in dispatch order."""
+    first: int
+    ids_host: np.ndarray              # [N, W], post-admission
+    surv_all: Optional[np.ndarray]
+    work_all: Optional[np.ndarray]
+    crash_at: Optional[int]
+    account: bool
+    metrics: object                   # round.RoundMetrics (futures)
+    bits: jax.Array                   # [N, D/32] change bitsets
+    t_dispatch0: float
+    t_dispatched: float
 
 
 class FedModel:
@@ -211,6 +243,29 @@ class FedModel:
         # participations would depress the completion ratio the
         # scheduler's survival estimate reads)
         self._plan_active = {}
+        # pipelined round engine (ISSUE 10): stage-side round counter
+        # (runs ahead of _rounds_done when a prefetched round/span has
+        # been staged but not yet committed; equal otherwise), the
+        # buffered async-admission state (--async_admit_rounds), and
+        # the off-critical-path checkpoint writer (--pipeline). All
+        # three are None/identity in the default config, so the
+        # default dispatch path is bit-identical to the pre-feature
+        # synchronous loop.
+        self._rounds_staged = 0
+        self.async_admit = (
+            AsyncAdmitBuffer(cfg.async_admit_rounds,
+                             cfg.async_staleness_decay)
+            if cfg.async_admit_rounds > 0 else None)
+        if cfg.pipeline:
+            # deferred import: utils.checkpoint imports federated.round
+            # for its (Server|Client)State types, so a module-level
+            # import here would be circular
+            from commefficient_tpu.utils.checkpoint import (
+                AsyncCheckpointWriter,
+            )
+            self.ckpt_writer = AsyncCheckpointWriter()
+        else:
+            self.ckpt_writer = None
 
     def attach_telemetry(self, session) -> None:
         """Install a telemetry.TelemetrySession (or None to detach).
@@ -252,6 +307,30 @@ class FedModel:
         stream state_dict, or None without one."""
         return (self.data_sampler.state_dict()
                 if self.data_sampler is not None else None)
+
+    def async_admit_state(self) -> Optional[dict]:
+        """The `asyb_*` checkpoint payload: pending async-admission
+        entries (federated/async_agg), or None when buffered async
+        aggregation is off — every checkpoint call site passes this
+        next to sampler_state()."""
+        return (self.async_admit.state_dict()
+                if self.async_admit is not None else None)
+
+    def drain_persistence(self) -> None:
+        """Block until every queued off-critical-path checkpoint write
+        (--pipeline's AsyncCheckpointWriter) is durable; a no-op
+        otherwise. Drivers call this before any SYNCHRONOUS save (the
+        manifest must rotate in order) and in their finally blocks, so
+        an InjectedFault drill flushes exactly like a clean
+        shutdown."""
+        if self.ckpt_writer is not None:
+            self.ckpt_writer.drain()
+
+    def close_persistence(self) -> None:
+        """drain_persistence + stop the writer thread (driver
+        shutdown). Idempotent."""
+        if self.ckpt_writer is not None:
+            self.ckpt_writer.close()
 
     def _scheduler_active(self) -> bool:
         """True when an attached scheduler can actually produce plans
@@ -336,7 +415,7 @@ class FedModel:
                 self.server, self.clients, span, lrs, self._key)
         return out
 
-    def client_rows_payload(self) -> Optional[dict]:
+    def client_rows_payload(self, clients=None) -> Optional[dict]:
         """The O(cohort) client-state checkpoint payload
         (utils/checkpoint `crows_*` keys): the touched-row id set, the
         gathered rows of every tracked state block for exactly those
@@ -350,8 +429,15 @@ class FedModel:
         The device gather pads the id list to a 256 multiple so its
         program recompiles O(log) times over a run, not per save; the
         host transfer is explicit (mh.gather_host), so span-boundary
-        saves stay transfer-guard-clean."""
-        tracked = [l.ndim == 2 for l in self.clients]
+        saves stay transfer-guard-clean.
+
+        `clients`: optional ClientState override — the pipelined span
+        checkpoint (training/scanloop snapshot) persists span t's
+        state while self.clients already points at span t+1's
+        in-flight result."""
+        if clients is None:
+            clients = self.clients
+        tracked = [l.ndim == 2 for l in clients]
         if not any(tracked):
             return None
         if not self._sparse_rows_ok:
@@ -374,7 +460,7 @@ class FedModel:
             if not used:
                 payload[name] = empty
                 continue
-            field = getattr(self.clients, name)
+            field = getattr(clients, name)
             payload[name] = np.asarray(
                 mh.gather_host(field[gidx]))[:len(ids)]
         return payload
@@ -594,11 +680,18 @@ class FedModel:
             # sampler.resolve_resume instead of the head-replay
             # fast-forward
             self.data_sampler.load_state_dict(ckpt.sampler)
+        if ckpt.async_admit and self.async_admit is not None:
+            # pending async admissions (asyb_* keys): the resumed run
+            # admits exactly what the uninterrupted one would have
+            self.async_admit.load_state_dict(ckpt.async_admit)
         if ckpt.prev_change_words is not None:
             self._prev_change_words = ckpt.prev_change_words
         # resync the host round mirror so dropout draws / crash points
-        # continue exactly where the checkpointed run left off
+        # continue exactly where the checkpointed run left off (the
+        # stage counter too: a resumed run has no in-flight prefetch —
+        # a lost one replays from the restored sampler cursor)
         self._rounds_done = int(np.asarray(ckpt.server.round_idx))
+        self._rounds_staged = self._rounds_done
         return ckpt.scheduler_step
 
     # -- internals --------------------------------------------------------
@@ -626,26 +719,19 @@ class FedModel:
             return lr * self.lr_scale_vec
         return lr
 
-    def _call_train(self, batch):
-        """batch = (client_ids, data, mask). `client_ids` is always the
-        GLOBAL [W] participant list (cheap; the sampler runs identically
-        on every process). In a multi-controller run, `data`/`mask`
-        carry ONLY this process's rows (FedLoader feed_slice →
-        multihost.local_row_slice): per-process batch feeding — no host
-        materializes the global batch."""
+    def stage_round(self, batch) -> _StagedRound:
+        """The HOST half of one round dispatch (ISSUE 10 split):
+        crash-in-flight check, fault/schedule composition, async
+        admission, and explicit operand placement — everything
+        `model(batch)` does before the device sees the round. Pure
+        host work keyed by the staged round index (deterministic fault
+        draws), so the pipelined driver may stage round t+1 while
+        round t executes on device; rounds must be staged and
+        committed in the same order. `_call_train` composes
+        stage+commit back-to-back, which IS the pre-split synchronous
+        path operation for operation."""
         client_ids, data, mask = batch
-        # donation contract (Config.donate_round_state): the round jit
-        # donates the gathered CohortState and the scatter-back jit
-        # donates the full ClientState — self.clients is reassigned
-        # from the result below and never read in between. ServerState
-        # is deliberately NOT donated on this path: the prev_weights
-        # reference captured here is read AFTER dispatch for the
-        # one-round-lagged accounting bitset, and a donated ps_weights
-        # would be a deleted buffer by then (round.ROUND_DEAD_ARGNUMS /
-        # SCATTER_DEAD_ARGNUMS are the authoritative declarations).
-        prev_weights = self.server.ps_weights
-
-        this_round = self._rounds_done
+        this_round = self._rounds_staged
         # mid-span preemption, per-round path: each round is its own
         # span of one — the kill lands while this round's program is
         # in flight, so NOTHING commits (state, accounting, counter)
@@ -655,6 +741,13 @@ class FedModel:
             self._journal_fault("crash_in_span", this_round - 1)
             raise InjectedFault(this_round - 1)
         survivors, work = self._faults_for_round(this_round, client_ids)
+        if self.async_admit is not None:
+            # buffered async aggregation (federated/async_agg): defer
+            # this round's stragglers onto the dropped-client path and
+            # merge admissions due this round into the cohort operands
+            (client_ids, data, mask, survivors,
+             work) = self.async_admit.compose(
+                this_round, client_ids, data, mask, survivors, work)
 
         P = self._P
         lr = self._lr()
@@ -666,25 +759,43 @@ class FedModel:
         lr = mh.globalize(self.mesh, P(),
                           lr if isinstance(lr, np.ndarray)
                           else np.float32(lr))
+        placed = fround.RoundBatch(
+            mh.globalize(self.mesh, P(),
+                         np.asarray(client_ids, np.int32)),
+            tuple(self._feed(d) for d in data),
+            self._feed(mask),
+            None if survivors is None
+            else mh.globalize(self.mesh, P(), survivors),
+            None if work is None
+            else mh.globalize(self.mesh, P(), work))
+        self._rounds_staged = this_round + 1
+        return _StagedRound(this_round, placed, lr,
+                            np.asarray(client_ids), survivors)
+
+    def commit_staged(self, staged: _StagedRound):
+        """The DISPATCH half: the gather->round->scatter bracket plus
+        the lagged accounting/telemetry bookkeeping. Donation contract
+        (Config.donate_round_state): the round jit donates the
+        gathered CohortState and the scatter-back jit donates the full
+        ClientState — self.clients is reassigned from the result below
+        and never read in between. ServerState is deliberately NOT
+        donated on this path: the prev_weights reference captured here
+        is read AFTER dispatch for the one-round-lagged accounting
+        bitset, and a donated ps_weights would be a deleted buffer by
+        then (round.ROUND_DEAD_ARGNUMS / SCATTER_DEAD_ARGNUMS are the
+        authoritative declarations)."""
+        prev_weights = self.server.ps_weights
+        this_round = staged.round_idx
         self.server, self.clients, metrics = self._train_round(
-            self.server, self.clients,
-            fround.RoundBatch(
-                mh.globalize(self.mesh, P(),
-                             np.asarray(client_ids, np.int32)),
-                tuple(self._feed(d) for d in data),
-                self._feed(mask),
-                None if survivors is None
-                else mh.globalize(self.mesh, P(), survivors),
-                None if work is None
-                else mh.globalize(self.mesh, P(), work)),
-            lr, self._key)
+            self.server, self.clients, staged.batch, staged.lr,
+            self._key)
         self._rounds_done = this_round + 1
         # O(cohort) checkpoint support: these rows may now differ from
         # their init values (dropped clients' rows were written back
         # bit-untouched, but over-including them only costs a few
         # zero rows in the sparse save)
         self._touched.update(
-            int(i) for i in np.asarray(client_ids).reshape(-1))
+            int(i) for i in staged.client_ids.reshape(-1))
 
         # Communication accounting with ONE round of lag: this round's
         # change bitset is dispatched and its device->host copy started
@@ -696,10 +807,10 @@ class FedModel:
         bits = self._pack_bits(self.server.ps_weights - prev_weights)
         bits.copy_to_host_async()
         download, upload = self.accountant.record_round(
-            np.asarray(client_ids),
+            staged.client_ids,
             None if self._prev_change_words is None
             else np.asarray(self._prev_change_words),
-            survivors=survivors)
+            survivors=staged.survivors)
         self._prev_change_words = bits
 
         # telemetry, one-round lag (same discipline as the metric
@@ -709,7 +820,7 @@ class FedModel:
         sched_mask = self._plan_active.pop(this_round, None)
         if self.telemetry is not None:
             self.telemetry.on_round(
-                this_round, np.asarray(client_ids),
+                this_round, staged.client_ids,
                 metrics.telemetry if self.cfg.telemetry else None,
                 metrics.num_examples,
                 comm=(float(download.sum()), float(upload.sum())),
@@ -727,10 +838,27 @@ class FedModel:
         # when to pay the sync (drivers materialize with a 1-round lag)
         return [metrics.losses, *metrics.metrics, download, upload]
 
+    def _call_train(self, batch):
+        """batch = (client_ids, data, mask). `client_ids` is always the
+        GLOBAL [W] participant list (cheap; the sampler runs identically
+        on every process). In a multi-controller run, `data`/`mask`
+        carry ONLY this process's rows (FedLoader feed_slice →
+        multihost.local_row_slice): per-process batch feeding — no host
+        materializes the global batch."""
+        return self.commit_staged(self.stage_round(batch))
+
     def run_rounds(self, client_ids, data, mask, lrs, account: bool = True):
         """Run N federated rounds as ONE device program (scanned; see
         round.train_rounds). client_ids: [N, W]; data: pytree of
         [N, W, B, ...]; mask: [N, W, B]; lrs: [N].
+
+        Composed from `dispatch_rounds` (host staging + the async
+        device dispatch) and `collect_rounds` (blocking on the span's
+        results, then accounting/telemetry/crash bookkeeping) — the
+        ISSUE 10 split the pipelined staging loop uses to overlap span
+        t+1's dispatch with span t's collection. Called through here
+        the two halves run back-to-back: the pre-split synchronous
+        behavior, operation for operation.
 
         Returns (losses [N, W], metrics [N, W]..., download, upload)
         with download/upload the span's total BYTES (scalars — the
@@ -753,6 +881,21 @@ class FedModel:
         instead kills it BEFORE any round commits (the host died while
         the span's device program was in flight) — resume must come
         from the last span boundary's checkpoint."""
+        return self.collect_rounds(
+            self.dispatch_rounds(client_ids, data, mask, lrs,
+                                 account=account))
+
+    def dispatch_rounds(self, client_ids, data, mask, lrs,
+                        account: bool = True) -> "_SpanHandle":
+        """Stage and DISPATCH one scanned span without blocking on its
+        results: fault/schedule composition and async admission per
+        round, explicit operand placement, the retry-guarded span
+        dispatch, and the state reassignment (the returned arrays are
+        futures — dispatch is asynchronous). Returns the handle
+        `collect_rounds` consumes; handles must be collected in
+        dispatch order. The pipelined staging loop dispatches span t+1
+        before collecting span t, so the device never idles on host
+        staging or persistence."""
         lrs = np.asarray(lrs, np.float32)
         ids_host = np.asarray(client_ids)
         n_rounds = ids_host.shape[0]
@@ -784,12 +927,45 @@ class FedModel:
         # can drop/slow — the operand-free treedefs keep the scanned
         # program a fault-free build traces). Any round with work
         # forces the full [N, W] pair: one scanned program per span.
+        # With async admission on, every round runs the composition
+        # pass (pending entries from earlier rounds/spans may admit
+        # here) and the composed ids/data/mask rows replace the staged
+        # ones — still a pure host-side merge on the cohort operands.
         surv_all = work_all = None
         if (self.cfg.client_dropout > 0 or self.cfg.straggler_rate > 0
                 or self.fault_schedule is not None
-                or self._scheduler_active()):
-            rows = [self._faults_for_round(first + n, ids_host[n])
-                    for n in range(n_rounds)]
+                or self._scheduler_active()
+                or self.async_admit is not None):
+            copied = False
+            rows = []
+            for n in range(n_rounds):
+                s, w = self._faults_for_round(first + n, ids_host[n])
+                if self.async_admit is not None:
+                    row_ids = ids_host[n]
+                    row_data = tuple(np.asarray(d)[n] for d in data)
+                    row_mask = np.asarray(mask)[n]
+                    ids_n, data_n, mask_n, s, w = \
+                        self.async_admit.compose(
+                            first + n, row_ids, row_data, row_mask,
+                            s, w)
+                    if ids_n is not row_ids:
+                        # an admission rewrote this round's cohort
+                        # rows — copy the span containers LAZILY (the
+                        # caller's staged arrays stay untouched; the
+                        # common nothing-due case pays no memcpy)
+                        if not copied:
+                            ids_host = np.array(ids_host, copy=True)
+                            data = tuple(
+                                np.array(np.asarray(d), copy=True)
+                                for d in data)
+                            mask = np.array(np.asarray(mask),
+                                            copy=True)
+                            copied = True
+                        ids_host[n] = ids_n
+                        for d, d_n in zip(data, data_n):
+                            d[n] = d_n
+                        mask[n] = mask_n
+                rows.append((s, w))
             ones = np.ones(ids_host.shape[1], np.float32)
             if any(w is not None for _, w in rows):
                 work_all = np.stack(
@@ -817,13 +993,17 @@ class FedModel:
         # Donation caveat (Config.donate_round_state, default on): the
         # span jit donates BOTH state operands (run_rounds reads
         # nothing after dispatch — even the change bitset comes from
-        # the span's result), so a failure DURING execution leaves
-        # them deleted and the retry surfaces a fatal
-        # array-deleted error instead of replaying; failures in the
-        # staging/globalize phase (where coordinator blips actually
-        # land) retry as before. --no_donate_round_state restores full
-        # span retryability at the cost of transiently doubled state
-        # HBM.
+        # the span's result), so once the dispatch has CONSUMED them a
+        # replay would re-dispatch deleted buffers. _span_classify
+        # below closes the ISSUE 7 caveat: a transient-looking failure
+        # is reclassified FATAL the moment any donated state leaf is
+        # already deleted — the ORIGINAL error re-raises instead of a
+        # retry that would either silently replay consumed state or
+        # surface a confusing array-deleted error one attempt later.
+        # Failures in the staging/globalize phase (where coordinator
+        # blips actually land) leave the operands alive and retry as
+        # before; --no_donate_round_state restores full span
+        # retryability at the cost of transiently doubled state HBM.
         def dispatch():
             return self._train_round.train_rounds(
                 self.server, self.clients,
@@ -847,14 +1027,49 @@ class FedModel:
                     attempt=int(attempt), delay_s=round(delay, 3),
                     error=repr(exc)[:200])
 
+        def _span_classify(exc: BaseException) -> bool:
+            """Transient AND safe to replay: with donation on, a
+            dispatch that already consumed its state operands must not
+            be re-dispatched (the ISSUE 7 retry caveat, now closed
+            mechanically — tests/test_pipeline.py regression)."""
+            if not is_transient_error(exc):
+                return False
+            if self._train_round.span_donate_argnums:
+                for leaf in jax.tree.leaves((self.server, self.clients)):
+                    if getattr(leaf, "is_deleted", lambda: False)():
+                        return False
+            return True
+
         t_dispatch0 = time.monotonic()
         self.server, self.clients, metrics, bits = with_retries(
             dispatch, describe="scanned round span",
-            on_retry=_journal_retry)
+            classify=_span_classify, on_retry=_journal_retry)
         t_dispatched = time.monotonic()
         self._rounds_done = first + n_rounds
+        self._rounds_staged = max(self._rounds_staged,
+                                  self._rounds_done)
         self._touched.update(
             int(i) for i in np.asarray(ids_host).reshape(-1))
+        return _SpanHandle(first=first, ids_host=ids_host,
+                           surv_all=surv_all, work_all=work_all,
+                           crash_at=crash_at, account=account,
+                           metrics=metrics, bits=bits,
+                           t_dispatch0=t_dispatch0,
+                           t_dispatched=t_dispatched)
+
+    def collect_rounds(self, handle: "_SpanHandle"):
+        """Block on a dispatched span's results and COMMIT it: the
+        accounting bitset device_get, per-round byte accounting, the
+        span-boundary telemetry export, the injected crash_after
+        boundary, and the metric gathers. Handles must be collected in
+        the order their spans were dispatched (accounting and the
+        change-bitset lag are sequential)."""
+        first = handle.first
+        ids_host = handle.ids_host
+        surv_all = handle.surv_all
+        metrics = handle.metrics
+        account = handle.account
+        crash_at = handle.crash_at
 
         # span byte totals (the accountant's per-round rows are
         # COHORT-indexed since ISSUE 9 — a population-length vector
@@ -866,7 +1081,7 @@ class FedModel:
         # explicit device_get (not np.asarray): run_rounds is
         # transfer-guard-clean end to end — tests arm
         # analysis/runtime.forbid_transfers around the whole call
-        bits_host = jax.device_get(bits)
+        bits_host = jax.device_get(handle.bits)
         t_blocked = time.monotonic()
 
         if self._prev_change_words is not None:
@@ -911,8 +1126,8 @@ class FedModel:
             counts_rows = mh.gather_host(metrics.num_examples)
             self.telemetry.on_span(
                 first, ids_host, tele_rows, counts_rows,
-                dispatch_s=t_dispatched - t_dispatch0,
-                block_s=t_blocked - t_dispatched,
+                dispatch_s=handle.t_dispatched - handle.t_dispatch0,
+                block_s=t_blocked - handle.t_dispatched,
                 comm_rows=comm_rows, scheduled_rows=sched_rows)
 
         if crash_at is not None:

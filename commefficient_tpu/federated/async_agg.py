@@ -1,0 +1,230 @@
+"""Buffered async aggregation: staleness-weighted late admission of
+straggler contributions (ISSUE 10).
+
+The synchronous straggler contract (PR 2) truncates a slow client at
+the round deadline: a work fraction f means only its first ceil(f *
+valid) examples ever count, and the rest of its local work is simply
+lost. FetchSGD's error-feedback formulation makes a better option
+safe — the sketch/update merge is LINEAR, so a late contribution is
+just another summand of a later round's aggregate ("Federated
+Learning: Strategies for Improving Communication Efficiency" frames
+the staleness/throughput trade-off; PAPERS.md).
+
+`AsyncAdmitBuffer` mechanizes that entirely on the host side of the
+dispatch, as a merge on the cohort operands the three traced round
+programs already take — ZERO new device programs:
+
+  * DEFER: a sampled slot with work fraction < 1 (random straggler
+    draw, FaultSchedule.slow, or a deadline truncation — anything
+    that survived the straggler cutoff) is pulled out of its round:
+    its survivor bit is zeroed (bit-exactly the dropped-client path —
+    no upload, state rows written back untouched, accounting charges
+    nothing) and its (client id, batch rows, work fraction) is
+    buffered with due round t + k;
+  * ADMIT: at round t+k the buffered entry is merged back into the
+    cohort operands — preferring its original slot if that slot is
+    idle, else any idle slot, else displacing the highest-index fresh
+    slot — with work fraction f * decay**rounds_late. The FedNova-
+    style processed-example reweighting the work operand already
+    implements (round.py shard_train) turns the discounted fraction
+    into a staleness-discounted aggregation weight: the late client's
+    transmit is scaled by (and the divide-by-total counts) the
+    discounted example budget.
+
+At k=0 the defer and the admit land in the SAME compose() call and
+the entry returns to its own slot with weight f * decay**0 == f in
+f32 — the composed operands are bit-identical to the synchronous
+straggler path (tests/test_pipeline.py asserts this), which is the
+identity anchoring the whole mechanism.
+
+Crash safety: pending entries ride in checkpoints (`asyb_*` keys,
+utils/checkpoint) so a resumed run admits exactly what the
+uninterrupted run would have; the buffer is bounded by construction
+(at most num_workers entries per in-flight round, k rounds deep).
+Single-controller only (Config.validate): entries hold batch rows,
+which are process-local under multihost feeding.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class _PendingEntry(NamedTuple):
+    client_id: int
+    origin: int                 # round the work was drawn for
+    due: int                    # origin + delay
+    frac: np.float32            # work completed by its own deadline
+    slot: int                   # cohort slot it occupied at origin
+    data: Tuple[np.ndarray, ...]  # that slot's batch rows
+    mask: np.ndarray
+
+
+class AsyncAdmitBuffer:
+    """Host-side defer/admit buffer for one run. `compose` is called
+    once per round (both dispatch paths) AFTER the fault/cutoff pass
+    (api._faults_for_round) and BEFORE operand placement, in round
+    order — the same discipline the fault draws follow, so the
+    composed stream is a pure function of (stream, faults, buffer
+    state) and crash->resume replays it bit-exactly once the buffer
+    state is restored."""
+
+    def __init__(self, delay: int, decay: float = 0.5):
+        if delay < 0:
+            raise ValueError(f"delay={delay} must be >= 0")
+        if not 0.0 < decay <= 1.0:
+            raise ValueError(f"decay={decay} must be in (0, 1]")
+        self.delay = int(delay)
+        self.decay = float(decay)
+        self._pending: List[_PendingEntry] = []
+
+    # ---------------- the math -------------------------------------------
+    def staleness_weight(self, rounds_late: int) -> np.float32:
+        """decay**rounds_late as f32 — the discount multiplied into an
+        admitted entry's work fraction. rounds_late == 0 yields exactly
+        f32(1.0), so same-round admission is weight-exact."""
+        if rounds_late < 0:
+            raise ValueError(f"rounds_late={rounds_late} must be >= 0")
+        return np.float32(self.decay ** int(rounds_late))
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    # ---------------- per-round composition ------------------------------
+    def compose(self, round_idx: int, client_ids, data, mask,
+                survivors: Optional[np.ndarray],
+                work: Optional[np.ndarray]):
+        """Defer this round's stragglers, admit entries due this
+        round. Returns (client_ids, data, mask, survivors, work) with
+        the same None-collapse conventions api._faults_for_round
+        established (all-ones work -> None; work present forces a
+        survivor vector). Inputs are returned UNTOUCHED — same array
+        objects — when the buffer neither defers nor admits, so the
+        async-off-equivalent rounds stay on the exact operands (and
+        therefore programs) a buffer-free build dispatches."""
+        round_idx = int(round_idx)
+        due = [e for e in self._pending if e.due <= round_idx]
+        if work is None and not due:
+            return client_ids, data, mask, survivors, work
+
+        ids = np.asarray(client_ids)
+        W = ids.shape[0]
+        surv_arr = (np.ones(W, np.float32) if survivors is None
+                    else np.asarray(survivors, np.float32).copy())
+        work_arr = (np.ones(W, np.float32) if work is None
+                    else np.asarray(work, np.float32).copy())
+        changed = False
+
+        # 1) defer: every live straggling slot leaves this round on
+        # the dropped-client path and enters the buffer
+        if work is not None:
+            for i in np.flatnonzero((work_arr < 1.0)
+                                    & (surv_arr > 0.0)):
+                i = int(i)
+                self._pending.append(_PendingEntry(
+                    client_id=int(ids[i]), origin=round_idx,
+                    due=round_idx + self.delay,
+                    frac=np.float32(work_arr[i]), slot=i,
+                    data=tuple(np.asarray(d)[i].copy() for d in data),
+                    mask=np.asarray(mask)[i].copy()))
+                surv_arr[i] = 0.0
+                work_arr[i] = 1.0
+                changed = True
+            # the just-deferred entries are due NOW at delay == 0
+            due = [e for e in self._pending if e.due <= round_idx]
+
+        # 2) admit: merge due entries back into the cohort operands
+        if due:
+            self._pending = [e for e in self._pending
+                             if e.due > round_idx]
+            ids = np.array(ids, copy=True)
+            data = tuple(np.array(d, copy=True) for d in data)
+            mask = np.array(mask, copy=True)
+            taken: set = set()
+            for e in due:  # FIFO — deterministic slot assignment
+                slot = self._pick_slot(e.slot, surv_arr, taken)
+                taken.add(slot)
+                ids[slot] = e.client_id
+                for d, row in zip(data, e.data):
+                    d[slot] = row
+                mask[slot] = e.mask
+                surv_arr[slot] = 1.0
+                work_arr[slot] = e.frac * self.staleness_weight(
+                    round_idx - e.origin)
+            changed = True
+
+        if not changed:
+            return client_ids, data, mask, survivors, work
+        # None-collapse, mirroring api._faults_for_round: an all-ones
+        # work vector traces the work-free program, and a surviving
+        # work vector always rides with a survivor operand
+        out_work: Optional[np.ndarray] = work_arr
+        if np.all(work_arr >= 1.0):
+            out_work = None
+        out_surv: Optional[np.ndarray] = surv_arr
+        if (out_work is None and survivors is None
+                and np.all(surv_arr >= 1.0)):
+            out_surv = None
+        return ids, data, mask, out_surv, out_work
+
+    @staticmethod
+    def _pick_slot(preferred: int, surv_arr: np.ndarray,
+                   taken: set) -> int:
+        """Deterministic admission slot: the entry's own origin slot
+        when idle (the k=0 bit-identity path), else the lowest-index
+        idle slot, else — a fully live round — displace from the END
+        (the highest-index fresh slot not already claimed this round;
+        the displaced draw simply doesn't run, like an over-
+        provisioned surplus slot)."""
+        if surv_arr[preferred] <= 0.0 and preferred not in taken:
+            return preferred
+        for i in range(len(surv_arr)):
+            if surv_arr[i] <= 0.0 and i not in taken:
+                return i
+        for i in range(len(surv_arr) - 1, -1, -1):
+            if i not in taken:
+                return i
+        raise ValueError("more admissions than cohort slots")
+
+    # ---------------- checkpoint round-trip ------------------------------
+    def state_dict(self) -> dict:
+        """Pending entries as plain arrays (`asyb_*` checkpoint keys).
+        Batch rows stack homogeneously — every entry came off the same
+        loader geometry — so the payload is a handful of [m, ...]
+        arrays, empty when nothing is pending."""
+        m = len(self._pending)
+        out = {
+            "ids": np.array([e.client_id for e in self._pending],
+                            np.int64),
+            "origin": np.array([e.origin for e in self._pending],
+                               np.int64),
+            "due": np.array([e.due for e in self._pending], np.int64),
+            "frac": np.array([e.frac for e in self._pending],
+                             np.float32),
+            "slot": np.array([e.slot for e in self._pending],
+                             np.int64),
+            "n_data": np.int64(len(self._pending[0].data) if m else 0),
+        }
+        if m:
+            out["mask"] = np.stack([e.mask for e in self._pending])
+            for j in range(int(out["n_data"])):
+                out[f"data{j}"] = np.stack(
+                    [e.data[j] for e in self._pending])
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        ids = np.asarray(state["ids"], np.int64)
+        self._pending = []
+        n_data = int(np.asarray(state.get("n_data", 0)))
+        for i in range(ids.shape[0]):
+            self._pending.append(_PendingEntry(
+                client_id=int(ids[i]),
+                origin=int(np.asarray(state["origin"])[i]),
+                due=int(np.asarray(state["due"])[i]),
+                frac=np.float32(np.asarray(state["frac"])[i]),
+                slot=int(np.asarray(state["slot"])[i]),
+                data=tuple(np.asarray(state[f"data{j}"])[i]
+                           for j in range(n_data)),
+                mask=np.asarray(state["mask"])[i]))

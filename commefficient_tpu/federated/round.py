@@ -754,7 +754,14 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                     else ())
     scatter_donate = (SCATTER_DEAD_ARGNUMS if cfg.donate_round_state
                       else ())
-    span_donate = SPAN_DEAD_ARGNUMS if cfg.donate_round_state else ()
+    # pipelined spans (Config.pipeline, ISSUE 10) keep their state
+    # operands ALIVE: span t+1 dispatches while span t's result state
+    # is still needed by the deferred span-boundary checkpoint, so
+    # donating it would hand the persistence path deleted buffers —
+    # double buffering pays with transiently doubled state HBM instead
+    span_donate = (SPAN_DEAD_ARGNUMS
+                   if cfg.donate_round_state and not cfg.pipeline
+                   else ())
     _gather_jit = jax.jit(gather_cohort,
                           out_shardings=_cohort_sharding())
     _scatter_jit = jax.jit(scatter_back, donate_argnums=scatter_donate,

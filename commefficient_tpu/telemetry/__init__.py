@@ -87,7 +87,11 @@ def attach_run_telemetry(model, cfg, log_dir: str, coord: bool,
     if coord:
         jpath = cfg.journal_path or os.path.join(
             log_dir or ".", "journal.jsonl")
-        journal = RunJournal(jpath, run_id=log_dir or driver)
+        # --pipeline: appends ride a bounded-queue writer thread (one
+        # fsync per queued batch, drained on close/crash) so journal
+        # durability leaves the round loop's critical path
+        journal = RunJournal(jpath, run_id=log_dir or driver,
+                             async_writer=bool(cfg.pipeline))
     tele = TelemetrySession(
         journal=journal, tracker=model.throughput,
         profile_spans=cfg.profile_spans,
@@ -265,10 +269,15 @@ class TelemetrySession:
         """Drain the one-round-lag buffer (end of epoch/run; before a
         deliberate crash boundary). The drained round has no interval
         measurement, so it journals without `seconds` and skips the
-        tracker."""
+        tracker. Also barriers the journal's async writer queue (a
+        no-op for the default synchronous journal), so a crash-
+        boundary caller knows its records are on disk before it
+        raises."""
         prev, self._pending = self._pending, None
         if prev is not None:
             self._emit_round(prev, None)
+        if self.journal is not None:
+            self._safe_write(self.journal.flush)
 
     # ---------------- span path (FedModel.run_rounds) --------------------
     def on_span(self, first_round: int, ids_rows: np.ndarray,

@@ -62,6 +62,8 @@ from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
@@ -115,13 +117,31 @@ class RunJournal:
     """Append-only JSONL writer for one run.
 
     Construction creates the parent directory but writes nothing; the
-    first `event()` call creates the file. The object is stateless
-    beyond its path — safe to reconstruct (e.g. `append_event`) and to
-    leave unclosed; every record is durable as soon as `event`
-    returns."""
+    first `event()` call creates the file. In the default synchronous
+    mode the object is stateless beyond its path — safe to
+    reconstruct (e.g. `append_event`) and to leave unclosed; every
+    record is durable as soon as `event` returns.
+
+    async_writer=True (ISSUE 10, Config.pipeline) moves the
+    flush+fsync onto a bounded-queue writer thread: `event`/`events`
+    SERIALIZE the record on the caller's thread (so later mutation of
+    passed values cannot corrupt it) and enqueue the finished lines;
+    one daemon thread drains the queue strictly FIFO through the same
+    `atomic_append_lines` path, so record content, ordering, batching
+    (a span's records stay ONE queued fsync) and the torn-tail seal
+    are byte-identical to the synchronous mode — only durability
+    timing changes. The queue is bounded (a dead disk back-pressures
+    rather than ballooning memory); `flush()` blocks until everything
+    queued is durable and `close()` flushes then stops the thread —
+    the crash-drill path (drivers close the session in `finally`)
+    drains exactly like a clean shutdown. Writer-side I/O failures
+    keep the best-effort contract: warn once, keep training."""
+
+    _SENTINEL = object()
 
     def __init__(self, path: str, run_id: str = "",
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 async_writer: bool = False, max_queue: int = 256):
         self.path = path
         self.run_id = run_id
         self._clock = clock
@@ -129,6 +149,15 @@ class RunJournal:
         # seal-check once, then skip the per-record read
         self._tail_checked = False
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._q: Optional["queue.Queue"] = None
+        self._thread = None
+        self._warned = False
+        if async_writer:
+            self._q = queue.Queue(maxsize=max(max_queue, 1))
+            self._thread = threading.Thread(
+                target=self._drain_loop, args=(self._q,),
+                name="journal-writer", daemon=True)
+            self._thread.start()
 
     def _record(self, kind: str, fields: dict) -> dict:
         rec = {"v": SCHEMA_VERSION, "event": str(kind),
@@ -138,13 +167,43 @@ class RunJournal:
         rec.update(fields)
         return rec
 
+    def _append(self, lines, check_tail: bool) -> None:
+        atomic_append_lines(self.path, lines, check_tail=check_tail)
+
+    def _drain_loop(self, q: "queue.Queue") -> None:
+        # the queue rides in as an argument: close() detaches self._q
+        # before the final join, and the loop must keep draining the
+        # ORIGINAL queue through that handoff
+        while True:
+            item = q.get()
+            try:
+                if item is self._SENTINEL:
+                    return
+                lines, check_tail = item
+                try:
+                    self._append(lines, check_tail)
+                except (OSError, ValueError) as e:
+                    # best-effort like the sync path's _safe_write
+                    # wrapper: observability must never kill training
+                    if not self._warned:
+                        print(f"journal writer: append failed ({e}); "
+                              f"further failures silent")
+                        self._warned = True
+            finally:
+                q.task_done()
+
+    def _emit(self, lines) -> None:
+        check_tail = not self._tail_checked
+        self._tail_checked = True
+        if self._q is None:
+            self._append(lines, check_tail)
+        else:
+            self._q.put((list(lines), check_tail))
+
     def event(self, kind: str, **fields) -> dict:
         """Append one record; returns the dict that was written."""
         rec = self._record(kind, fields)
-        atomic_append_lines(
-            self.path, (json.dumps(_finite(rec), default=_jsonable),),
-            check_tail=not self._tail_checked)
-        self._tail_checked = True
+        self._emit((json.dumps(_finite(rec), default=_jsonable),))
         return rec
 
     def events(self, batch) -> List[dict]:
@@ -152,18 +211,31 @@ class RunJournal:
         ONE flush+fsync for the lot. The span-boundary path uses this:
         a span's N round records are produced at the same instant, so
         per-record fsyncs would buy no durability, only a host stall
-        proportional to span length."""
+        proportional to span length. Under the async writer the whole
+        batch rides the queue as ONE item — still one fsync."""
         recs = [self._record(kind, fields) for kind, fields in batch]
-        atomic_append_lines(
-            self.path,
-            [json.dumps(_finite(r), default=_jsonable) for r in recs],
-            check_tail=not self._tail_checked)
-        self._tail_checked = True
+        self._emit([json.dumps(_finite(r), default=_jsonable)
+                    for r in recs])
         return recs
 
+    def flush(self) -> None:
+        """Block until every queued record is durable (async mode); a
+        no-op in synchronous mode, where `event` already fsynced. The
+        crash-boundary writers (FedModel._journal_fault) call this so
+        an injected_fault record is on disk before the raise."""
+        if self._q is not None:
+            self._q.join()
+
     def close(self) -> None:
-        """No buffered state to flush (every event is already durable);
-        kept so callers can treat the journal like a file handle."""
+        """Drain and stop the writer thread (async mode); in sync mode
+        there is no buffered state — kept so callers can treat the
+        journal like a file handle. Idempotent."""
+        if self._q is not None:
+            q, self._q = self._q, None
+            q.join()
+            q.put(self._SENTINEL)
+            self._thread.join()
+            self._thread = None
 
 
 def append_event(path: str, kind: str, **fields) -> dict:

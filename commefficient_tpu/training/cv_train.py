@@ -279,7 +279,11 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 # loss to ckpt_every_spans spans, not one epoch
                 checkpoint=make_span_checkpoint(
                     _ckpt_path(cfg), model, cfg, lr_scheduler),
-                guard=guard)
+                guard=guard,
+                # --pipeline: double-buffered dispatch — span t+1
+                # stages/dispatches while span t runs on device and
+                # span t-1 persists (ISSUE 10)
+                pipeline=cfg.pipeline)
             rounds_done += taken
         else:
             # metrics materialize with a ONE-ROUND lag: float()ing the
@@ -391,6 +395,9 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             # a loadable checkpoint for --resume (utils/checkpoint)
             import time
             t0 = time.monotonic()  # monotonic like the sibling sites
+            # queued span-boundary writes (--pipeline) must land
+            # before this synchronous save rotates the manifest
+            model.drain_persistence()
             path = save_rotating(
                 _ckpt_path(cfg), model.server, model.clients,
                 keep_last=cfg.keep_checkpoints,
@@ -402,6 +409,7 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 throughput=model.throughput.state_dict(),
                 scheduler=model.scheduler_state(),
                 sampler=model.sampler_state(),
+                async_admit=model.async_admit_state(),
                 client_rows=model.client_rows_payload())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
@@ -574,6 +582,7 @@ def main(argv=None) -> bool:
             # collective (gathers sharded client state); coordinator
             # writes stamped + manifest (what --resume prefers) AND the
             # fixed-name artifact the finetune path loads, in one gather
+            model.drain_persistence()
             path = save_final(
                 _ckpt_path(cfg), model.server, model.clients,
                 keep_last=cfg.keep_checkpoints,
@@ -585,6 +594,7 @@ def main(argv=None) -> bool:
                 throughput=model.throughput.state_dict(),
                 scheduler=model.scheduler_state(),
                 sampler=model.sampler_state(),
+                async_admit=model.async_admit_state(),
                 client_rows=model.client_rows_payload())
             if coord:
                 print(f"saved checkpoint to {path}")
@@ -592,9 +602,14 @@ def main(argv=None) -> bool:
         # close even when training raises (an InjectedFault drill, a
         # NaN abort, a real crash): the session must detach its global
         # compile listener and stop any live profiler capture, or the
-        # next in-process run inherits both
-        if tele is not None:
-            tele.close(ok=bool(ok))
+        # next in-process run inherits both. The persistence writer
+        # drains FIRST (--pipeline): a queued span checkpoint flushes
+        # at a crash exactly like at a clean shutdown.
+        try:
+            model.close_persistence()
+        finally:
+            if tele is not None:
+                tele.close(ok=bool(ok))
     return ok
 
 

@@ -271,7 +271,9 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 # loss to ckpt_every_spans spans, not one epoch
                 checkpoint=make_span_checkpoint(
                     ckpt_path, model, cfg, lr_scheduler),
-                guard=guard)
+                guard=guard,
+                # --pipeline: double-buffered dispatch (ISSUE 10)
+                pipeline=cfg.pipeline)
         else:
             stream_it = iter(epoch_stream)
             while True:
@@ -336,6 +338,9 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
             # atomic rotated save (keep-last-k + `latest` manifest) —
             # the preemption-safe half of --resume (utils/checkpoint)
             t0 = time.monotonic()
+            # queued span-boundary writes (--pipeline) must land
+            # before this synchronous save rotates the manifest
+            model.drain_persistence()
             written = save_rotating(
                 ckpt_path, model.server, model.clients,
                 keep_last=cfg.keep_checkpoints,
@@ -347,6 +352,7 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
                 throughput=model.throughput.state_dict(),
                 scheduler=model.scheduler_state(),
                 sampler=model.sampler_state(),
+                async_admit=model.async_admit_state(),
                 client_rows=model.client_rows_payload())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
@@ -581,6 +587,7 @@ def main(argv=None) -> bool:
             if cfg.do_checkpoint:
                 # stamped + manifest (what --resume prefers) AND the
                 # fixed-name artifact, in one collective gather
+                model.drain_persistence()
                 save_final(ckpt_path, model.server, model.clients,
                            keep_last=cfg.keep_checkpoints,
                            max_age_hours=cfg.ckpt_max_age_hours,
@@ -591,6 +598,7 @@ def main(argv=None) -> bool:
                            throughput=model.throughput.state_dict(),
                            scheduler=model.scheduler_state(),
                            sampler=model.sampler_state(),
+                           async_admit=model.async_admit_state(),
                            client_rows=model.client_rows_payload())
             # HF-style final artifact: tokenizer + config + weights
             # (reference gpt2_train.py:275-283, fed_aggregator.py:208-211)
@@ -608,9 +616,14 @@ def main(argv=None) -> bool:
     finally:
         # close even when training raises (fault drill, NaN abort):
         # the global compile listener and any live profiler capture
-        # must not leak into the next in-process run
-        if tele is not None:
-            tele.close(ok=bool(ok))
+        # must not leak into the next in-process run. The persistence
+        # writer drains FIRST (--pipeline): a queued span checkpoint
+        # flushes at a crash exactly like at a clean shutdown.
+        try:
+            model.close_persistence()
+        finally:
+            if tele is not None:
+                tele.close(ok=bool(ok))
     return ok
 
 

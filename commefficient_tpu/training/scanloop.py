@@ -6,6 +6,17 @@ Both drivers run the same mechanics (span_cap derivation, host-side
 [N, W, B, ...] staging, the np.stack flush, the partial tail span) and
 previously each carried its own copy; only what they DO with a round's
 metric rows differs, so that part is the `emit` callback.
+
+Pipelined mode (ISSUE 10, Config.pipeline / `pipeline=True` here)
+double-buffers the dispatch: a span is DISPATCHED as soon as it is
+staged (FedModel.dispatch_rounds — asynchronous, the device starts as
+soon as its predecessor finishes) and COLLECTED one flush later
+(FedModel.collect_rounds — accounting, journal, checkpoint, emits),
+so span t+1's host staging (sampler draws, batch fetch/transform,
+np.stack, fault operands, explicit placement) and span t-1's
+persistence overlap span t's device execution. The synchronous path
+(`pipeline=False`, the default) is the identical code running the two
+halves back-to-back — bit-identical to the pre-feature loop.
 """
 from __future__ import annotations
 
@@ -23,7 +34,8 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
                                                   None]] = None,
                        on_flush: Optional[Callable[[int], None]] = None,
                        checkpoint: Optional[Callable[[], None]] = None,
-                       guard: Optional[Callable] = None
+                       guard: Optional[Callable] = None,
+                       pipeline: bool = False
                        ) -> bool:
     """Drive scanned spans over `stream`, which yields
     (tag, client_ids, data_tuple, mask, lr) per round — the caller owns
@@ -64,9 +76,79 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
     span_profile_begin/end bracket each flush, so the trace covers
     exactly the requested spans' real device work.
 
+    `pipeline=True` (Config.pipeline) defers each span's commit —
+    on_flush/on_comm/checkpoint/emits — to the NEXT flush, after the
+    following span has already been dispatched (module docstring).
+    Three contracts shift, all bounded by one span: a NaN/emit abort
+    lands one span later (the next span's state has already committed
+    by then, exactly like the sync path's abort-after-commit
+    semantics); an injected crash while two spans are in flight loses
+    both back to the last *persisted* boundary (a real preemption
+    does too); and the checkpoint hook receives the SNAPSHOT captured
+    at the span's own boundary — state references plus the sampler/
+    scheduler/admit-buffer cursors as of that span's draws — via the
+    hook's `snapshot` kwarg (make_span_checkpoint provides the
+    `.snapshot` factory; hooks without one are called with no
+    arguments and read live state, which in pipelined mode is one
+    span ahead — use make_span_checkpoint). A prefetch lost to a
+    crash (span t+1's draws when the run dies collecting span t)
+    replays from the checkpointed sampler cursor: the snapshot was
+    taken BEFORE the prefetch advanced it.
+
     Returns True if every emit succeeded, False on abort.
     """
     ids, datas, masks, lrs, tags = [], [], [], [], []
+    snapshot_fn = getattr(checkpoint, "snapshot", None)
+    # pipelined double buffer: the one dispatched-but-uncollected span
+    pending = []  # [(handle, tags, span_idx, snapshot)]
+
+    def commit(out, span_tags, snap) -> bool:
+        """The span's host-side commit: wall-time/comm callbacks,
+        the boundary checkpoint, then the per-round emits."""
+        *metric_rows, down, up = out
+        if on_flush is not None:
+            on_flush(len(span_tags))
+        if on_comm is not None:
+            on_comm(down, up)
+        if checkpoint is not None:
+            if snap is not None:
+                checkpoint(snapshot=snap)
+            else:
+                checkpoint()
+        for n in range(len(span_tags)):
+            if not emit(span_tags[n], *[m[n] for m in metric_rows]):
+                return False
+        return True
+
+    def collect_pending() -> bool:
+        handle, span_tags, span_idx, snap = pending.pop()
+        tele = getattr(model, "telemetry", None)
+        out = model.collect_rounds(handle)
+        if tele is not None:
+            tele.span_profile_end(span_idx)
+        return commit(out, span_tags, snap)
+
+    def drain_pending_on_abort() -> None:
+        """An emit abort surfaces one span late in pipelined mode, with
+        the NEXT span already dispatched (its state assigned to the
+        model). Collect that span's accounting/telemetry — and feed
+        on_flush/on_comm — so the model's accountant, change-bitset lag
+        and byte totals stay consistent with its (already advanced)
+        weights for the drivers' post-abort saves; skip its emits (the
+        run is aborting) and its boundary checkpoint (a NaN abort must
+        not poison --resume with a post-abort state)."""
+        if not pending:
+            return
+        handle, span_tags, span_idx, _ = pending.pop()
+        tele = getattr(model, "telemetry", None)
+        out = model.collect_rounds(handle)
+        if tele is not None:
+            tele.span_profile_end(span_idx)
+        *_, down, up = out
+        if on_flush is not None:
+            on_flush(len(span_tags))
+        if on_comm is not None:
+            on_comm(down, up)
 
     def flush() -> bool:
         span_idx = getattr(model, "_spans_dispatched", 0)
@@ -75,26 +157,45 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
             tele.span_profile_begin(span_idx)
         ctx = (guard() if guard is not None and span_idx > 0
                else contextlib.nullcontext())
-        with ctx:
-            out = model.run_rounds(
-                np.stack(ids),
+        args = (np.stack(ids),
                 tuple(np.stack([dd[i] for dd in datas])
                       for i in range(len(datas[0]))),
                 np.stack(masks), np.asarray(lrs))
-        if tele is not None:
-            tele.span_profile_end(span_idx)
+        if not pipeline:
+            with ctx:
+                out = model.run_rounds(*args)
+            if tele is not None:
+                tele.span_profile_end(span_idx)
+            model._spans_dispatched = span_idx + 1
+            return commit(out, list(tags), None)
+        # pipelined: an injected crash boundary in the PENDING span
+        # must surface before more work dispatches (the sync path
+        # raised inside its own flush) — collect it first, which
+        # raises InjectedFault at the same round boundary
+        if pending and pending[0][0].crash_at is not None:
+            collect_pending()
+        with ctx:
+            handle = model.dispatch_rounds(*args)
         model._spans_dispatched = span_idx + 1
-        *metric_rows, down, up = out
-        if on_flush is not None:
-            on_flush(len(ids))
-        if on_comm is not None:
-            on_comm(down, up)
-        if checkpoint is not None:
-            checkpoint()
-        for n in range(len(ids)):
-            if not emit(tags[n], *[m[n] for m in metric_rows]):
-                return False
-        return True
+        # the span's boundary snapshot: state refs (the span program's
+        # result futures, just assigned) + the persistent-stream
+        # cursors as of THIS span's draws — captured before the next
+        # span's pulls advance them
+        snap = snapshot_fn() if snapshot_fn is not None else None
+        prev_ok = True
+        if pending:
+            prev_ok = collect_pending()
+        if snap is not None:
+            # the throughput tracker commits at COLLECT time, so it is
+            # captured AFTER the previous span's collect: exactly the
+            # state the NEXT span's selection draws will observe. A
+            # resume from this boundary re-draws that span against the
+            # identical tracker — saving the live (one-span-richer)
+            # state at save time instead would silently diverge a
+            # throughput-sampled resumed stream.
+            snap["throughput"] = model.throughput.state_dict()
+        pending.append((handle, list(tags), span_idx, snap))
+        return prev_ok
 
     for tag, client_ids, data, mask, lr in stream:
         ids.append(client_ids)
@@ -104,10 +205,15 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
         tags.append(tag)
         if len(ids) == span_cap:
             if not flush():
+                drain_pending_on_abort()
                 return False
             ids, datas, masks, lrs, tags = [], [], [], [], []
     if ids:
-        return flush()
+        if not flush():
+            drain_pending_on_abort()
+            return False
+    if pending:
+        return collect_pending()
     return True
 
 
@@ -121,7 +227,17 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
     Each save is a full server+client state gather plus a disk write,
     which is why the cadence is a knob: 1 (the default) bounds a
     mid-span preemption's loss to one span, larger values trade
-    recovery granularity for save rate on big models."""
+    recovery granularity for save rate on big models.
+
+    The hook carries a `.snapshot` attribute — the pipelined staging
+    loop calls it at each span's own boundary (right after dispatch,
+    before the next span's draws) and hands the result back through
+    the hook's `snapshot` kwarg, so a one-span-late save persists the
+    RIGHT span: its state references and the sampler/scheduler/
+    admit-buffer cursors as of its draws, not the live (one-span-
+    ahead) ones. Under Config.pipeline the serialization itself rides
+    the model's AsyncCheckpointWriter — the gather happens here, the
+    np.savez/fsync/rename on the writer thread."""
     if not (cfg.checkpoint_every and cfg.ckpt_every_spans):
         return None
     from commefficient_tpu.parallel import multihost as mh
@@ -129,31 +245,61 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
 
     spans_done = [0]
 
-    def span_checkpoint():
+    def take_snapshot() -> dict:
+        # captured at the span's own boundary (pipelined: right after
+        # its dispatch, before the next span's draws). Deliberately
+        # NOT here: _prev_change_words, the accountant, and the
+        # throughput tracker — those commit at COLLECT time in span
+        # order, so the live read at save time is the span-consistent
+        # one on both paths.
+        return {
+            "server": model.server,
+            "clients": model.clients,
+            "scheduler_step": lr_scheduler.step_count,
+            "sampler": model.sampler_state(),
+            "scheduler": model.scheduler_state(),
+            "async_admit": model.async_admit_state(),
+        }
+
+    def span_checkpoint(snapshot=None):
         spans_done[0] += 1
         if spans_done[0] % cfg.ckpt_every_spans:
             return
+        if snapshot is None:
+            snapshot = take_snapshot()
         t0 = time.monotonic()
         path = save_rotating(
-            prefix, model.server, model.clients,
+            prefix, snapshot["server"], snapshot["clients"],
             keep_last=cfg.keep_checkpoints,
             max_age_hours=cfg.ckpt_max_age_hours,
-            scheduler_step=lr_scheduler.step_count,
+            scheduler_step=snapshot["scheduler_step"],
             accountant=model.accountant,
             prev_change_words=model._prev_change_words,
             fingerprint=model.checkpoint_fingerprint,
-            throughput=model.throughput.state_dict(),
-            scheduler=model.scheduler_state(),
-            sampler=model.sampler_state(),
-            client_rows=model.client_rows_payload())
+            # pipelined snapshots carry the tracker state the next
+            # span's draws observed (captured post-collect in the
+            # staging loop); the sync path reads live — same value
+            # there, since nothing collected in between
+            throughput=(snapshot["throughput"]
+                        if "throughput" in snapshot
+                        else model.throughput.state_dict()),
+            scheduler=snapshot["scheduler"],
+            sampler=snapshot["sampler"],
+            async_admit=snapshot["async_admit"],
+            client_rows=model.client_rows_payload(
+                clients=snapshot["clients"]),
+            writer=model.ckpt_writer)
         tele = getattr(model, "telemetry", None)
         if tele is not None:
             # the save is a full state gather + disk write — exactly
             # the wall-clock span the journal exists to attribute
+            # (under the async writer, `seconds` covers the gather
+            # and queueing; the write itself is off-path by design)
             tele.journal_event("checkpoint", path=path,
                                seconds=round(time.monotonic() - t0, 3),
                                span_boundary=True)
         if mh.is_coordinator():
             print(f"checkpointed to {path}")
 
+    span_checkpoint.snapshot = take_snapshot
     return span_checkpoint

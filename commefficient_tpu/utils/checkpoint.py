@@ -32,8 +32,10 @@ from __future__ import annotations
 import glob as _glob
 import json
 import os
+import queue
 import shutil
-from typing import NamedTuple, Optional, Tuple
+import threading
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -85,6 +87,85 @@ def validate_fingerprint(found: dict, expected: dict,
             raise CheckpointMismatchError(path, k, found[k], expected[k])
 
 
+class AsyncCheckpointWriter:
+    """Bounded-queue writer thread for off-critical-path checkpoint
+    persistence (ISSUE 10, Config.pipeline).
+
+    The device->host state GATHER stays on the caller's thread (it is
+    collective in multi-controller runs and must block on span
+    completion anyway); what moves off the critical path is the
+    SERIALIZATION — np.savez + flush + fsync + atomic rename, plus the
+    manifest/prune bookkeeping — which at checkpoint-every-span
+    cadence otherwise stalls the round loop for the full disk write.
+    Jobs run strictly FIFO on one thread, so the stamped file always
+    lands before its manifest entry and rotation order is preserved;
+    the atomic `.tmp` + os.replace discipline is unchanged (the
+    closures are the same code, just executed later).
+
+    The queue is BOUNDED (default: one write in flight plus one
+    queued): a slow disk back-pressures the training loop instead of
+    accumulating unbounded dirty state in memory. `drain()` blocks
+    until every submitted write is durable and re-raises the first
+    writer-side failure on the caller's thread — callers drain before
+    any synchronous save (ordering) and in their crash/finally paths,
+    so an InjectedFault drill flushes exactly like a clean shutdown."""
+
+    _SENTINEL = object()
+
+    def __init__(self, max_pending: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(max_pending, 1))
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                if job is self._SENTINEL:
+                    return
+                try:
+                    job()
+                except BaseException as e:  # graftlint: disable=GL005 -- not swallowed: deferred re-raise on the caller's thread at drain()/submit() (_raise_pending); jobs are write closures, never fault-harness code
+                    if self._exc is None:
+                        self._exc = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Queue one write closure; blocks when the queue is full (the
+        bounded-memory back-pressure). A failure from an EARLIER job
+        re-raises here so write errors surface at the next save, not
+        silently at shutdown."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        self._raise_pending()
+        self._q.put(job)
+
+    def drain(self) -> None:
+        """Block until every submitted write is durable; re-raise the
+        first writer-side failure."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Drain, then stop the thread. Idempotent."""
+        if self._closed:
+            return
+        self._q.join()
+        self._closed = True
+        self._q.put(self._SENTINEL)
+        self._thread.join()
+        self._raise_pending()
+
+
 class Checkpoint(NamedTuple):
     """Loaded training state; accounting state rides along so resumed
     runs keep cumulative comm totals correct, the per-client
@@ -112,6 +193,11 @@ class Checkpoint(NamedTuple):
     # population (FedModel.client_rows_payload / load_state). When
     # present, `clients` above is None: the two formats are exclusive.
     client_rows: Optional[dict] = None
+    # pending async-admission entries (ISSUE 10, `asyb_*` keys):
+    # deferred straggler contributions not yet admitted
+    # (federated/async_agg.AsyncAdmitBuffer.state_dict), so a resumed
+    # run admits exactly what the uninterrupted one would have
+    async_admit: Optional[dict] = None
 
 
 def save_checkpoint(path: str, server: ServerState,
@@ -125,7 +211,10 @@ def save_checkpoint(path: str, server: ServerState,
                     throughput: Optional[dict] = None,
                     scheduler: Optional[dict] = None,
                     sampler: Optional[dict] = None,
-                    client_rows: Optional[dict] = None) -> str:
+                    client_rows: Optional[dict] = None,
+                    async_admit: Optional[dict] = None,
+                    writer: Optional[AsyncCheckpointWriter] = None
+                    ) -> str:
     """Write training state to `path` (.npz appended if absent).
     Per-client state can be excluded (include_clients=False) to keep
     files small when clients are stateless (error_type != local and
@@ -189,16 +278,37 @@ def save_checkpoint(path: str, server: ServerState,
         # sampling — same bit-exact-resume contract as thr_*/sched_*
         for k, v in sampler.items():
             arrays[f"smp_{k}"] = np.asarray(v)
+    if async_admit is not None:
+        # pending async-admission entries (ISSUE 10): deferred
+        # straggler contributions awaiting their admit round — same
+        # bit-exact-resume contract as thr_*/sched_*/smp_*
+        for k, v in async_admit.items():
+            arrays[f"asyb_{k}"] = np.asarray(v)
     if fingerprint is not None:
         for k in FINGERPRINT_FIELDS:
             arrays[f"fp_{k}"] = np.asarray(str(fingerprint[k]))
-    if mh.is_coordinator():
+
+    def _write():
+        # the atomic .tmp + os.replace write — unchanged whether it
+        # runs inline or (writer given) on the persistence thread
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+
+    if mh.is_coordinator():
+        if writer is None:
+            _write()
+        else:
+            # off-critical-path serialization (Config.pipeline): the
+            # gathers above already completed on this thread (they are
+            # collective and block on device state anyway); only the
+            # coordinator-local disk write is deferred. Durability is
+            # writer.drain()'s contract — callers drain before any
+            # synchronous save and at shutdown/crash.
+            writer.submit(_write)
     mh.sync_processes("checkpoint-written")
     return path
 
@@ -279,9 +389,12 @@ def load_checkpoint(path: str,
              if k.startswith("sched_")}
     smp = {k[len("smp_"):]: z[k] for k in z.files
            if k.startswith("smp_")}
+    asyb = {k[len("asyb_"):]: z[k] for k in z.files
+            if k.startswith("asyb_")}
     return Checkpoint(server, clients, int(z["scheduler_step"]),
                       acct or None, prev, fingerprint, thr or None,
-                      sched or None, smp or None, client_rows)
+                      sched or None, smp or None, client_rows,
+                      asyb or None)
 
 
 # ---------------- keep-last-k rotation + latest manifest -----------------
@@ -311,6 +424,7 @@ def _atomic_write_text(path: str, text: str) -> None:
 def save_rotating(prefix: str, server: ServerState,
                   clients: Optional[ClientState] = None,
                   keep_last: int = 3, max_age_hours: float = 0.0,
+                  writer: Optional[AsyncCheckpointWriter] = None,
                   **kw) -> str:
     """Atomic round-stamped save + `<prefix>.latest` manifest update +
     keep-last-k pruning. Returns the written path.
@@ -332,8 +446,9 @@ def save_rotating(prefix: str, server: ServerState,
     lists — `latest` included — names a file that survived pruning."""
     round_idx = int(np.asarray(mh.gather_host(server.round_idx)))
     path = f"{prefix}-r{round_idx:08d}.npz"
-    save_checkpoint(path, server, clients, **kw)
-    if mh.is_coordinator():
+    save_checkpoint(path, server, clients, writer=writer, **kw)
+
+    def _manifest_and_prune():
         base = os.path.basename(path)
         mpath = _manifest_path(prefix)
         history = []
@@ -380,6 +495,16 @@ def save_rotating(prefix: str, server: ServerState,
                     os.remove(old)
                 except OSError:
                     pass
+
+    if mh.is_coordinator():
+        if writer is None:
+            _manifest_and_prune()
+        else:
+            # FIFO on the single writer thread: the stamped .npz write
+            # submitted by save_checkpoint above lands before this
+            # manifest update, preserving the "manifest never points
+            # at a missing file" invariant
+            writer.submit(_manifest_and_prune)
     mh.sync_processes("checkpoint-rotated")
     return path
 
@@ -395,9 +520,15 @@ def save_final(prefix: str, server: ServerState,
     atomic copy of the stamped bytes, not a second gather+serialize
     (which would double a multi-GB device->host transfer at
     shutdown). Returns the fixed-name path."""
+    writer = kw.pop("writer", None)
     stamped = save_rotating(prefix, server, clients,
                             keep_last=keep_last,
-                            max_age_hours=max_age_hours, **kw)
+                            max_age_hours=max_age_hours,
+                            writer=writer, **kw)
+    if writer is not None:
+        # the fixed-name copy below reads the stamped bytes — the
+        # queued write must be durable first
+        writer.drain()
     fixed = prefix if prefix.endswith(".npz") else prefix + ".npz"
     if mh.is_coordinator():
         tmp = fixed + ".tmp"

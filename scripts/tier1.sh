@@ -111,6 +111,33 @@ if [ "$rc" -eq 0 ]; then
   python scripts/journal_summary.py "$JR3" \
       || { echo "PALLAS_JOURNAL_INVALID"; exit 1; }
 
+  # pipelined-driver smoke (ISSUE 10 satellite): the same tiny scanned
+  # run under --pipeline (double-buffered dispatch + writer-thread
+  # journal/checkpoint persistence) with --async_admit_rounds 1 and a
+  # heavy random-straggler load — the production twin of
+  # FaultSchedule.slow (both feed the same work-fraction operand) —
+  # plus per-span rotated checkpoints so the async checkpoint writer
+  # runs end-to-end. The journal it writes (round/span/checkpoint
+  # events from the one-span-late commit path) must pass the same
+  # invariant check, so the pipelined record stream cannot rot.
+  JR5=/tmp/_t1_journal_pipe.jsonl
+  rm -f "$JR5"
+  rm -rf /tmp/_t1_pipe_ckpt
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode uncompressed \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --scan_rounds --scan_span 1 --pipeline --async_admit_rounds 1 \
+      --straggler_rate 0.6 --straggler_min_work 0.4 \
+      --checkpoint --checkpoint_every 1 \
+      --checkpoint_path /tmp/_t1_pipe_ckpt \
+      --journal_path "$JR5" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "PIPELINE_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR5" \
+      || { echo "PIPELINE_JOURNAL_INVALID"; exit 1; }
+
   # large-population smoke (ISSUE 9 satellite): the O(active) refactor
   # driven end-to-end at a 100k-client population with the --test tiny
   # model (D=100) and local_topk + local error + momentum + topk_down,
