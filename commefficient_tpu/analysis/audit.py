@@ -117,6 +117,12 @@ AUDIT_POPULATION = 23
 # baseline must be regenerated.
 AUDIT_GEOMETRY = dict(D=1024, W=8, B=4, k=64, rows=3, cols=256)
 
+# the tiered config's device working set (ISSUE 11): >= W, divisible
+# by every registered mesh clients-axis size (8 and 4) so the mesh
+# tier shards the block without padding, and distinct from both the
+# population sentinel and every geometry dim
+TIER_WORKING_SET = 16
+
 
 @dataclasses.dataclass(frozen=True, order=True)
 class AuditFinding:
@@ -390,6 +396,18 @@ def audit_configs(backends: Sequence[str] = ("xla", "pallas"),
         mode="local_topk", error_type="local", local_momentum=0.9,
         do_topk_down=True, k=g["k"], down_k=32,
         **base).validate()))
+    # tiered cold client state (ISSUE 11): the same client-state
+    # workload with a bounded device working set — its gather/scatter
+    # trace over the [working_set, D] block (no population-shaped
+    # value ANYWHERE, not even in the state-motion inventory: the
+    # million-user residency claim as an audited program property).
+    # TIER_WORKING_SET divides every registered mesh clients axis so
+    # the mesh tier shards the block without padding.
+    out.append(("client-state-tiered", Config(
+        mode="local_topk", error_type="local", local_momentum=0.9,
+        do_topk_down=True, k=g["k"], down_k=32, state_tier="host",
+        state_working_set=TIER_WORKING_SET,
+        **base).validate()))
     return out
 
 
@@ -401,8 +419,8 @@ def build_workload(cfg):
     import jax.numpy as jnp
 
     from commefficient_tpu.federated.round import (
-        RoundBatch, audit_batch_variants, init_client_state,
-        init_server_state, make_train_fn,
+        RoundBatch, audit_batch_variants, client_state_rows,
+        init_client_state, init_server_state, make_train_fn,
     )
     from commefficient_tpu.ops.flat import flatten_params
     from commefficient_tpu.parallel.mesh import make_client_mesh
@@ -425,7 +443,11 @@ def build_workload(cfg):
     mesh = make_client_mesh(1)
     handle = make_train_fn(loss_fn, unravel, cfg, mesh)
     server = init_server_state(cfg, vec)
-    clients = init_client_state(cfg, AUDIT_POPULATION, vec)
+    # client_state_rows: the tiered config (state_tier=host) allocates
+    # its bounded [working_set, D] block — the gather/scatter the
+    # auditor walks are then the slot-indexed tiered programs
+    clients = init_client_state(
+        cfg, client_state_rows(cfg, AUDIT_POPULATION), vec)
     batch = RoundBatch(
         jnp.arange(g["W"], dtype=jnp.int32),
         (jnp.zeros((g["W"], g["B"], g["D"]), jnp.float32),

@@ -225,7 +225,8 @@ def build_mesh_workload(cfg, mesh):
     from jax.sharding import PartitionSpec as P
 
     from commefficient_tpu.federated.round import (
-        RoundBatch, init_client_state, init_server_state, make_train_fn,
+        RoundBatch, client_state_rows, init_client_state,
+        init_server_state, make_train_fn,
     )
     from commefficient_tpu.ops.flat import flatten_params
     from commefficient_tpu.parallel import multihost as mh
@@ -244,7 +245,10 @@ def build_mesh_workload(cfg, mesh):
     vec, unravel = flatten_params(params)
     handle = make_train_fn(loss_fn, unravel, cfg, mesh)
     server = init_server_state(cfg, vec, mesh=mesh)
-    clients = init_client_state(cfg, MESH_POPULATION, vec, mesh=mesh)
+    # the tiered config (ISSUE 11) shards its bounded [working_set, D]
+    # block over the same clients axis — client_state_rows routes it
+    clients = init_client_state(
+        cfg, client_state_rows(cfg, MESH_POPULATION), vec, mesh=mesh)
     batch = RoundBatch(
         mh.globalize(mesh, P(), np.arange(g["W"], dtype=np.int32)),
         (mh.shard_rows(mesh, np.zeros((g["W"], g["B"], g["D"]),

@@ -356,6 +356,43 @@ class Config:
     # work fraction: weight = decay**rounds_late (1.0 = no discount)
     async_staleness_decay: float = 0.5
 
+    # tiered cold client state (ISSUE 11). "device" — the default —
+    # keeps the full [padded_population, D] client-state blocks
+    # sharded in device HBM (bit-identical to the pre-feature
+    # program: the tier machinery is never constructed). "host" caps
+    # the device-resident rows at an LRU working set of
+    # `state_working_set` recently-active clients; the long tail of
+    # cold rows lives on the host (optionally disk-backed via
+    # `state_spill_dir`), and the cohort-gather/scatter-back
+    # state-motion pair moves rows between tiers: a sampled client
+    # outside the working set is RESTORED into a device slot before
+    # its round (through the same scatter program, as host-built
+    # cohort rows) and the evicted victim's row is SPILLED to the
+    # host tier off the critical path (the same gather program + an
+    # async device->host copy on a bounded-queue writer thread — the
+    # ISSUE-10 persistence pattern). The three round programs still
+    # see only [num_workers, D] cohort operands (AU004 strict keeps
+    # them honest), results are bit-identical to state_tier=device
+    # (f32 rows round-trip the host exactly), and device HBM for
+    # client state is O(working set) regardless of the population.
+    # Single-controller only for now (the host tail is process-local;
+    # per-process sharded tails are a ROADMAP opening).
+    state_tier: str = "device"
+    # device-HBM working-set size in client rows (state_tier=host):
+    # the LRU keeps at most this many clients' state rows resident
+    # (rounded up to the mesh's clients axis). Must be >= num_workers
+    # (a round's whole cohort must fit), and on the scanned path
+    # >= the distinct clients of one span (the span executes as one
+    # device program, so its rows must all be resident at once —
+    # FedModel raises an actionable error otherwise).
+    state_working_set: int = 0
+    # optional disk backing for the host tail (state_tier=host): cold
+    # rows live in per-block f32 memmaps under this directory instead
+    # of process RAM — sparse files, so untouched rows cost nothing.
+    # Scratch state: rebuilt from the checkpoint's crows_* rows on
+    # resume, never loaded across runs.
+    state_spill_dir: str = ""
+
     # set after model construction (reference mutates args.grad_size at
     # fed_aggregator.py:88; we return a new frozen Config instead)
     grad_size: int = 0
@@ -596,6 +633,44 @@ class Config:
                 "cross-process barriers, and the admit buffer holds "
                 "process-local batch rows (coordinator-broadcast "
                 "scheduling is the named ROADMAP opening)")
+        if self.state_tier not in ("device", "host"):
+            raise ValueError(
+                f"unknown state_tier {self.state_tier!r} (choices: "
+                "device — full population in device HBM, the default — "
+                "or host — LRU working set on device, cold tail on "
+                "host; federated/statestore.py)")
+        if self.state_working_set < 0:
+            raise ValueError("state_working_set must be >= 0")
+        if self.state_tier != "device":
+            if self.state_working_set <= 0:
+                raise ValueError(
+                    "--state_tier host requires --state_working_set N "
+                    "(the device-HBM row budget; must be >= "
+                    "num_workers)")
+            if self.state_working_set < self.num_workers:
+                raise ValueError(
+                    f"state_working_set={self.state_working_set} < "
+                    f"num_workers={self.num_workers}: one round's "
+                    "whole cohort must fit in the device working set")
+            if self.multihost:
+                raise ValueError(
+                    "--state_tier host is single-controller only for "
+                    "now: the host tail is process-local state and "
+                    "would need per-process sharded spill/restore "
+                    "(the coordinator-broadcast ROADMAP opening)")
+        if self.state_spill_dir and self.state_tier == "device":
+            raise ValueError(
+                "--state_spill_dir backs the HOST tail and requires "
+                "--state_tier host (the device tier has no tail to "
+                "spill)")
+        if self.state_working_set > 0 and self.state_tier == "device":
+            # fail loud rather than silently allocating the full
+            # [padded_population, D] blocks in HBM — the exact OOM
+            # the flag was set to prevent
+            raise ValueError(
+                "--state_working_set caps the device-resident rows of "
+                "the HOST tier and requires --state_tier host (the "
+                "device tier keeps every row in HBM, uncapped)")
         if self.kernel_backend not in ("xla", "pallas"):
             raise ValueError(
                 f"unknown kernel_backend {self.kernel_backend!r} "
@@ -758,6 +833,25 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                    help="per-round decay of a late-admitted "
                         "contribution's work fraction: weight = "
                         "decay**rounds_late (1.0 = undiscounted)")
+    p.add_argument("--state_tier", choices=("device", "host"),
+                   default="device",
+                   help="client-state residency tier: device (full "
+                        "population sharded in device HBM, the "
+                        "default — bit-identical to the pre-feature "
+                        "program) or host (LRU working set of "
+                        "--state_working_set rows on device, cold "
+                        "tail spilled to host off the critical path; "
+                        "federated/statestore.py)")
+    p.add_argument("--state_working_set", type=int, default=0,
+                   help="with --state_tier host: device-HBM working-"
+                        "set size in client rows (>= num_workers; "
+                        "on the scanned path >= a span's distinct "
+                        "clients)")
+    p.add_argument("--state_spill_dir", type=str, default="",
+                   help="with --state_tier host: disk-back the host "
+                        "tail with sparse f32 memmaps under this "
+                        "directory (scratch state, rebuilt from "
+                        "crows_* checkpoints on resume)")
     p.add_argument("--sampler", choices=("uniform", "throughput"),
                    default="uniform",
                    help="participant-sampling policy: uniform (bit-"

@@ -68,6 +68,14 @@ class _StagedRound(NamedTuple):
     lr: jax.Array
     client_ids: np.ndarray            # host copy, post-admission
     survivors: Optional[np.ndarray]   # host copy (accounting)
+    # tiered client state (ISSUE 11, Config.state_tier=host): the
+    # round's LRU slot assignment + spill/restore motion, decided at
+    # stage time (pure host bookkeeping, deterministic in the cohort
+    # stream) and executed against the device block at commit time.
+    # None under the default device tier. When set, `batch.client_ids`
+    # carries device SLOTS, not global ids — the gather/scatter
+    # programs index the bounded working-set block.
+    tier_plan: Optional[object] = None
 
 
 class _SpanHandle(NamedTuple):
@@ -169,8 +177,23 @@ class FedModel:
             self._loss_val, self.unravel, cfg, self.mesh)
 
         self.server = fround.init_server_state(cfg, vec, mesh=self.mesh)
+        # tiered cold client state (ISSUE 11): under state_tier=host
+        # the ClientState blocks hold only the LRU working set —
+        # client_state_rows picks the allocation — and the store below
+        # conducts slot assignment, host spill, and restore through
+        # the SAME gather/scatter state-motion programs.
         self.clients = fround.init_client_state(
-            cfg, self.num_clients, vec, mesh=self.mesh)
+            cfg, fround.client_state_rows(cfg, self.num_clients), vec,
+            mesh=self.mesh)
+        self.state_store = None
+        if cfg.state_tier != "device":
+            from commefficient_tpu.federated.statestore import (
+                TieredStateStore, tracked_fields,
+            )
+            if any(tracked_fields(cfg).values()):
+                self.state_store = TieredStateStore(
+                    cfg, self.mesh, self._train_round, vec,
+                    self.num_clients)
         # O(cohort) checkpointing (ISSUE 9): client-state rows are zero
         # (or the init-weights tile, topk_down) until a client first
         # participates, so checkpoints persist only the rows of
@@ -284,6 +307,14 @@ class FedModel:
         _faults_for_round; scheduler state rides in checkpoints under
         `sched_*` keys and load_state restores it."""
         self.scheduler = scheduler
+        if scheduler is not None:
+            # working-set-aware prefetch (ISSUE 11): the scheduler's
+            # commit_round warms the HOST side of an upcoming plan's
+            # cohort restores — LRU-neutral, so prefetch timing can
+            # never perturb the eviction stream
+            scheduler.state_prefetch = (
+                self.state_store.prefetch_host_rows
+                if self.state_store is not None else None)
 
     def scheduler_state(self) -> Optional[dict]:
         """The `sched_*` checkpoint payload: the attached scheduler's
@@ -322,15 +353,21 @@ class FedModel:
         otherwise. Drivers call this before any SYNCHRONOUS save (the
         manifest must rotate in order) and in their finally blocks, so
         an InjectedFault drill flushes exactly like a clean
-        shutdown."""
+        shutdown. Also drains the tiered state store's spill queue
+        (state_tier=host) so every evicted row is durable in the host
+        tail."""
         if self.ckpt_writer is not None:
             self.ckpt_writer.drain()
+        if self.state_store is not None:
+            self.state_store.flush()
 
     def close_persistence(self) -> None:
-        """drain_persistence + stop the writer thread (driver
+        """drain_persistence + stop the writer threads (driver
         shutdown). Idempotent."""
         if self.ckpt_writer is not None:
             self.ckpt_writer.close()
+        if self.state_store is not None:
+            self.state_store.close()
 
     def _scheduler_active(self) -> bool:
         """True when an attached scheduler can actually produce plans
@@ -415,7 +452,9 @@ class FedModel:
                 self.server, self.clients, span, lrs, self._key)
         return out
 
-    def client_rows_payload(self, clients=None) -> Optional[dict]:
+    def client_rows_payload(self, clients=None,
+                            tier: Optional[dict] = None
+                            ) -> Optional[dict]:
         """The O(cohort) client-state checkpoint payload
         (utils/checkpoint `crows_*` keys): the touched-row id set, the
         gathered rows of every tracked state block for exactly those
@@ -434,9 +473,21 @@ class FedModel:
         `clients`: optional ClientState override — the pipelined span
         checkpoint (training/scanloop snapshot) persists span t's
         state while self.clients already points at span t+1's
-        in-flight result."""
+        in-flight result. `tier`: the matching snapshot_tier() dict
+        under state_tier=host (the LRU/touched bookkeeping at that
+        same boundary).
+
+        Under the tiered store (state_tier=host) the payload comes
+        from the store instead: resident rows via an O(working set)
+        padded-256 SLOT gather, evicted rows straight from the host
+        tail with no device work at all (the satellite fix — a cold
+        million-client tail costs the save zero gather bytes), plus
+        the LRU order/slot map so resume replays the exact eviction
+        stream."""
         if clients is None:
             clients = self.clients
+        if self.state_store is not None:
+            return self.state_store.checkpoint_rows(clients, tier=tier)
         tracked = [l.ndim == 2 for l in clients]
         if not any(tracked):
             return None
@@ -611,11 +662,15 @@ class FedModel:
                                  "<loaded checkpoint>")
         P = self._P
         s = ckpt.server
+        # globalize_owned, not globalize: the scanned span DONATES the
+        # server state, so the resumed buffers must be XLA-owned — a
+        # zero-copied checkpoint numpy array in the donation chain is
+        # the heap-corruption class multihost.zeros documents
         self.server = fround.ServerState(
-            mh.globalize(self.mesh, P(), s.ps_weights),
-            mh.globalize(self.mesh, P(), s.Vvelocity),
-            mh.globalize(self.mesh, P(), s.Verror),
-            mh.globalize(self.mesh, P(), s.round_idx))
+            mh.globalize_owned(self.mesh, P(), s.ps_weights),
+            mh.globalize_owned(self.mesh, P(), s.Vvelocity),
+            mh.globalize_owned(self.mesh, P(), s.Verror),
+            mh.globalize_owned(self.mesh, P(), s.round_idx))
         if ckpt.client_rows is not None:
             # O(cohort) checkpoint (crows_* keys): rebuild the sharded
             # population blocks from init — zeros, or the saved
@@ -631,6 +686,29 @@ class FedModel:
             base = (self._init_weights_host
                     if self._init_weights_host is not None
                     else np.asarray(ckpt.server.ps_weights, np.float32))
+            if self.state_store is not None:
+                # tiered store (ISSUE 11): fresh working-set block at
+                # init values, then the store rebuilds the tiers —
+                # rows recorded resident (crows_lru_*) scatter back
+                # into their slots so the eviction stream replays;
+                # everything else (incl. a payload written by a
+                # state_tier=device run, which has no lru keys) lands
+                # in the host tail. Bit-exact either way: residency
+                # never changes row values.
+                self.state_store.set_init_weights(
+                    self._init_weights_host)
+                self.clients = fround.init_client_state(
+                    self.cfg,
+                    fround.client_state_rows(self.cfg,
+                                             self.num_clients),
+                    jnp.asarray(base), mesh=self.mesh)
+                self.clients = self.state_store.load_rows(
+                    self.clients, rows)
+                # the store's LRU + tail are the touched set for a
+                # tiered model; the host _touched mirror stays unused
+                self._sparse_rows_ok = True
+                self._finish_load(ckpt)
+                return ckpt.scheduler_step
             self.clients = fround.init_client_state(
                 self.cfg, self.num_clients, jnp.asarray(base),
                 mesh=self.mesh)
@@ -652,17 +730,40 @@ class FedModel:
                         **{name: field.at[gidx].set(placed)})
                 self.clients = new
         elif ckpt.clients is not None:
-            # legacy dense client blocks: place them whole. The
-            # touched-row set is unrecoverable from a dense save, so
-            # this model's own checkpoints fall back to the dense
-            # format from here on (client_rows_payload -> None) rather
-            # than silently dropping pre-resume rows from sparse saves.
-            specs = fround.client_state_specs(ckpt.clients)
-            self.clients = fround.ClientState(*[
-                mh.globalize(self.mesh, spec, np.asarray(field))
-                for field, spec in zip(ckpt.clients, specs)])
-            if any(np.asarray(f).ndim == 2 for f in ckpt.clients):
-                self._sparse_rows_ok = False
+            if self.state_store is not None:
+                # legacy dense blocks into the tiered store: the
+                # vectorized diff against init recovers the touched
+                # set the dense format never recorded; touched rows
+                # land in the host tail, the working set starts cold,
+                # and this model's own saves stay sparse
+                dense = {name: np.asarray(getattr(ckpt.clients, name))
+                         for name in self.state_store.fields}
+                self.state_store.import_dense(dense)
+                self._sparse_rows_ok = True
+            else:
+                # legacy dense client blocks: place them whole. The
+                # touched-row set is unrecoverable from a dense save,
+                # so this model's own checkpoints fall back to the
+                # dense format from here on (client_rows_payload ->
+                # None) rather than silently dropping pre-resume rows
+                # from sparse saves.
+                specs = fround.client_state_specs(ckpt.clients)
+                # globalize_owned: these blocks enter the scatter/span
+                # donation chain (see the server fields above)
+                self.clients = fround.ClientState(*[
+                    mh.globalize_owned(self.mesh, spec,
+                                       np.asarray(field))
+                    for field, spec in zip(ckpt.clients, specs)])
+                if any(np.asarray(f).ndim == 2 for f in ckpt.clients):
+                    self._sparse_rows_ok = False
+        self._finish_load(ckpt)
+        return ckpt.scheduler_step
+
+    def _finish_load(self, ckpt) -> None:
+        """The state-block-independent half of load_state: accounting,
+        throughput, scheduler, sampler, async-admission, and the host
+        round mirrors — shared by the device-tier and tiered-store
+        resume paths."""
         if ckpt.accountant_state:
             self.accountant.load_state_dict(ckpt.accountant_state)
         if ckpt.throughput:
@@ -692,7 +793,6 @@ class FedModel:
         # a lost one replays from the restored sampler cursor)
         self._rounds_done = int(np.asarray(ckpt.server.round_idx))
         self._rounds_staged = self._rounds_done
-        return ckpt.scheduler_step
 
     # -- internals --------------------------------------------------------
     def _feed(self, rows, leading_axes: int = 0):
@@ -749,6 +849,17 @@ class FedModel:
              work) = self.async_admit.compose(
                 this_round, client_ids, data, mask, survivors, work)
 
+        # tiered client state (ISSUE 11): assign device slots AFTER
+        # admission composition (an admitted client needs a slot too).
+        # Pure host bookkeeping — the spill/restore device ops run at
+        # commit time against the then-current block, so staging may
+        # still run one round ahead under Config.pipeline.
+        tier_plan = None
+        ids_for_device = np.asarray(client_ids, np.int32)
+        if self.state_store is not None:
+            tier_plan = self.state_store.plan_round(client_ids)
+            ids_for_device = tier_plan.slots
+
         P = self._P
         lr = self._lr()
         # explicit placement for BOTH lr shapes: a raw python float
@@ -760,8 +871,7 @@ class FedModel:
                           lr if isinstance(lr, np.ndarray)
                           else np.float32(lr))
         placed = fround.RoundBatch(
-            mh.globalize(self.mesh, P(),
-                         np.asarray(client_ids, np.int32)),
+            mh.globalize(self.mesh, P(), ids_for_device),
             tuple(self._feed(d) for d in data),
             self._feed(mask),
             None if survivors is None
@@ -770,7 +880,8 @@ class FedModel:
             else mh.globalize(self.mesh, P(), work))
         self._rounds_staged = this_round + 1
         return _StagedRound(this_round, placed, lr,
-                            np.asarray(client_ids), survivors)
+                            np.asarray(client_ids), survivors,
+                            tier_plan)
 
     def commit_staged(self, staged: _StagedRound):
         """The DISPATCH half: the gather->round->scatter bracket plus
@@ -786,6 +897,16 @@ class FedModel:
         authoritative declarations)."""
         prev_weights = self.server.ps_weights
         this_round = staged.round_idx
+        if staged.tier_plan is not None:
+            # tier motion first (ISSUE 11): spill-gather the plan's
+            # eviction victims from the CURRENT block (their values
+            # include every earlier round's scatter-back), then
+            # restore-scatter the misses' host rows into their slots —
+            # both through the round handle's existing state-motion
+            # programs, so the gather below reads a fully-resident
+            # working set
+            self.clients = self.state_store.execute(
+                self.clients, staged.tier_plan)
         self.server, self.clients, metrics = self._train_round(
             self.server, self.clients, staged.batch, staged.lr,
             self._key)
@@ -793,9 +914,12 @@ class FedModel:
         # O(cohort) checkpoint support: these rows may now differ from
         # their init values (dropped clients' rows were written back
         # bit-untouched, but over-including them only costs a few
-        # zero rows in the sparse save)
-        self._touched.update(
-            int(i) for i in staged.client_ids.reshape(-1))
+        # zero rows in the sparse save). The tiered store tracks its
+        # own touched set (LRU + tail) — this host mirror would be
+        # write-only dead weight there.
+        if self.state_store is None:
+            self._touched.update(
+                int(i) for i in staged.client_ids.reshape(-1))
 
         # Communication accounting with ONE round of lag: this round's
         # change bitset is dispatched and its device->host copy started
@@ -825,6 +949,14 @@ class FedModel:
                 metrics.num_examples,
                 comm=(float(download.sum()), float(upload.sum())),
                 scheduled=sched_mask)
+            if self.state_store is not None:
+                # tier residency telemetry (ISSUE 11): working-set
+                # hit/miss and spill/restore deltas for this round —
+                # journal-schema-checked by validate_journal, hit rate
+                # surfaced by summarize()
+                self.telemetry.journal_event(
+                    "state_tier", round=this_round,
+                    **self.state_store.take_journal_fields())
 
         # injected preemption: the round above fully completed (state,
         # accounting, round counter) — crash at the exact boundary a
@@ -976,6 +1108,25 @@ class FedModel:
                 surv_all = np.stack(
                     [s if s is not None else ones for s, _ in rows])
 
+        # tiered client state (ISSUE 11): the span executes as ONE
+        # device program with the working-set block on the scan carry,
+        # so every miss is restored (and every victim spilled) up
+        # front, each round's plan pinning the span's later cohorts
+        # resident (plan_span raises an actionable error when the
+        # working set cannot hold a span's distinct clients). Under
+        # Config.pipeline this staging overlaps the PREVIOUS span's
+        # device execution — the prefetch the tier needs to stay off
+        # the critical path. The dispatched id operand becomes the
+        # per-round SLOT rows; ids_host keeps the global ids for
+        # accounting/telemetry.
+        ids_device = ids_host
+        if self.state_store is not None:
+            plans = self.state_store.plan_span(ids_host)
+            for plan in plans:
+                self.clients = self.state_store.execute(
+                    self.clients, plan)
+            ids_device = np.stack([p.slots for p in plans])
+
         if self.lr_scale_vec is not None:
             # per-parameter LR scaling — same routing _lr() applies on
             # the single-round path (incl. fedavg: the vector reaches
@@ -1009,7 +1160,7 @@ class FedModel:
                 self.server, self.clients,
                 fround.RoundBatch(
                     mh.globalize(self.mesh, P(),
-                                 np.asarray(ids_host, np.int32)),
+                                 np.asarray(ids_device, np.int32)),
                     tuple(self._feed(d, leading_axes=1)
                           for d in data),
                     self._feed(mask, leading_axes=1),
@@ -1048,8 +1199,11 @@ class FedModel:
         self._rounds_done = first + n_rounds
         self._rounds_staged = max(self._rounds_staged,
                                   self._rounds_done)
-        self._touched.update(
-            int(i) for i in np.asarray(ids_host).reshape(-1))
+        if self.state_store is None:
+            # tiered models track touched ids in the store (see
+            # commit_staged)
+            self._touched.update(
+                int(i) for i in np.asarray(ids_host).reshape(-1))
         return _SpanHandle(first=first, ids_host=ids_host,
                            surv_all=surv_all, work_all=work_all,
                            crash_at=crash_at, account=account,
@@ -1129,6 +1283,16 @@ class FedModel:
                 dispatch_s=handle.t_dispatched - handle.t_dispatch0,
                 block_s=t_blocked - handle.t_dispatched,
                 comm_rows=comm_rows, scheduled_rows=sched_rows)
+            if self.state_store is not None:
+                # per-span tier residency record (ISSUE 11). Under
+                # Config.pipeline the deltas attribute the NEXT span's
+                # already-staged motion to this span's record — a
+                # bounded, documented skew (the journal is validated
+                # on schema, not on per-span attribution)
+                self.telemetry.journal_event(
+                    "state_tier", first_round=first,
+                    rounds=int(ids_host.shape[0]),
+                    **self.state_store.take_journal_fields())
 
         if crash_at is not None:
             # every completed round's state/accounting landed above —

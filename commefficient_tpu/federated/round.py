@@ -66,7 +66,13 @@ class ClientState(NamedTuple):
 
     The jitted round NEVER takes this treedef as an operand: only the
     cohort-gather and scatter-back state-motion programs touch it
-    (module docstring; graftaudit AU004 enforces the contract)."""
+    (module docstring; graftaudit AU004 enforces the contract).
+
+    Under `Config.state_tier=host` (ISSUE 11) the same treedef holds
+    the bounded [working_set, ...] device block instead — rows are
+    indexed by LRU slot, not client id, and the cold tail lives on
+    the host (federated/statestore.py; client_state_rows picks the
+    allocation size)."""
     errors: jax.Array            # [padded_population, D] or [0]
     velocities: jax.Array        # [padded_population, D] or [0]
     weights: jax.Array           # [padded_population, D] or [0]
@@ -230,6 +236,22 @@ def init_client_state(cfg: Config, num_clients: int,
 
 def _has_errors(cfg): return cfg.error_type == "local"
 def _has_velocities(cfg): return cfg.local_momentum > 0
+
+
+def client_state_rows(cfg: Config, num_clients: int) -> int:
+    """How many client rows this config's ClientState blocks are
+    allocated for: the full population under the default
+    `state_tier=device`, or the bounded LRU working set under
+    `state_tier=host` (ISSUE 11) — the blocks then hold only
+    recently-active clients' rows while the cold tail lives on the
+    host (federated/statestore.py), and the SAME gather/scatter
+    state-motion programs move rows by device SLOT index instead of
+    global client id. Every allocator of a ClientState (FedModel, the
+    audit tiers, bench sweeps) routes through this so the audited
+    gather/scatter programs are the dispatched ones."""
+    if cfg.state_tier != "device":
+        return int(cfg.state_working_set)
+    return int(num_clients)
 
 
 # ---------------------------------------------------------------------------
@@ -752,7 +774,16 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
     # the call (see TrainRound docstring for the caller contract).
     round_donate = (ROUND_DEAD_ARGNUMS if cfg.donate_round_state
                     else ())
-    scatter_donate = (SCATTER_DEAD_ARGNUMS if cfg.donate_round_state
+    # pipelined TIERED staging (ISSUE 11 + ISSUE 10): span t+1's
+    # restore-scatters run against span t's result block while the
+    # deferred span-boundary checkpoint still reads it, so the
+    # scatter keeps its operand alive — transiently doubled block
+    # HBM, bounded by the working set (the same trade the span jit
+    # makes below)
+    scatter_donate = (SCATTER_DEAD_ARGNUMS
+                      if cfg.donate_round_state
+                      and not (cfg.pipeline
+                               and cfg.state_tier != "device")
                       else ())
     # pipelined spans (Config.pipeline, ISSUE 10) keep their state
     # operands ALIVE: span t+1 dispatches while span t's result state
@@ -842,6 +873,11 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
     handle = TrainRound()
     handle.train_rounds = train_rounds
     handle.round_step = round_step
+    # the gather program's declared cohort placement — the tiered
+    # state store (federated/statestore) places its host-built
+    # restore rows with exactly these shardings so the restore hits
+    # the same compiled scatter program the post-round writeback uses
+    handle.cohort_shardings = _cohort_sharding()
     handle.round_full = round_full
     handle.gather = _gather_jit
     handle.scatter = _scatter_jit

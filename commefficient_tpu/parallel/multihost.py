@@ -35,6 +35,7 @@ single-chip bench and the 8-device CPU test mesh are untouched.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -224,6 +225,42 @@ def globalize(mesh: Mesh, spec: P, value) -> jax.Array:
         arr.shape, sharding, lambda idx: arr[idx])
 
 
+# jitted allocation builders, cached so repeated shapes reuse one jit
+# wrapper (a fresh jax.jit(lambda ...) per call would retrace+compile
+# every invocation); NamedSharding/np.dtype/tuple keys are hashable
+@functools.lru_cache(maxsize=512)
+def _jit_zeros(shape: Tuple[int, ...], dtype, sharding):
+    return jax.jit(lambda: jnp.zeros(shape, dtype),
+                   out_shardings=sharding)
+
+
+@functools.lru_cache(maxsize=128)
+def _jit_broadcast(shape: Tuple[int, ...], sharding):
+    return jax.jit(lambda v: jnp.broadcast_to(v, shape),
+                   out_shardings=sharding)
+
+
+@functools.lru_cache(maxsize=128)
+def _jit_copy(sharding):
+    return jax.jit(lambda x: x.copy(), out_shardings=sharding)
+
+
+def globalize_owned(mesh: Mesh, spec: P, value) -> jax.Array:
+    """globalize + guarantee the result owns an XLA-allocated buffer.
+
+    For values that enter the round engine's DONATION chain — the
+    resumed server/client state a checkpoint loader places — a plain
+    device_put of a large aligned numpy array may be ZERO-COPY on the
+    CPU backend: the "device" buffer aliases numpy-owned heap memory,
+    and the donated in-place update chain then writes into (and
+    eventually frees) memory XLA does not own — intermittent glibc
+    heap corruption (see zeros() below; found by the ISSUE-11 verify
+    drive). The jitted copy forces a fresh XLA output allocation;
+    values only ever READ by programs don't need this."""
+    arr = globalize(mesh, spec, value)
+    return _jit_copy(NamedSharding(mesh, spec))(arr)
+
+
 def shard_rows(mesh: Mesh, local_rows, leading_axes: int = 0) -> jax.Array:
     """Per-process batch feeding: build the global ``[W, ...]`` round
     array from THIS process's rows only.
@@ -321,12 +358,30 @@ def zeros(mesh: Mesh, spec: P, shape: Tuple[int, ...],
     never materialize host-globally."""
     sharding = NamedSharding(mesh, spec)
     if not is_multihost():
-        # host-side np.zeros + explicit device_put: no throwaway
-        # device-default placement to reshard, no implicit transfer
-        return jax.device_put(np.zeros(shape, np.dtype(dtype)), sharding)
+        # allocate ON DEVICE (jitted zeros with explicit out_shardings
+        # — no transfer at all, so trivially transfer-guard-clean).
+        # Deliberately NOT device_put(np.zeros(...)): jax zero-copies
+        # large aligned numpy buffers into CPU device arrays, and the
+        # round engine DONATES these blocks — the in-place donation
+        # chain then writes into (and eventually frees) numpy-owned
+        # heap memory for the rest of the run, which intermittently
+        # corrupts the allocator on the CPU thunk runtime (glibc
+        # "free(): invalid pointer" / "corrupted size vs. prev_size";
+        # observed on the scanned local_topk driver, ISSUE 11 verify).
+        # A device-native buffer keeps the whole donation chain inside
+        # XLA's allocator.
+        return _jit_zeros(tuple(shape), np.dtype(dtype), sharding)()
+    # multihost: shard-local host staging. A jitted device-side copy
+    # (the single-process fix above) is not an option here — the CPU
+    # backend cannot run cross-process computations, so the grid
+    # emulation would fail before it ever trained — hence the shard
+    # buffers are made un-zero-copyable instead, which forces
+    # device_put to copy them into XLA-owned memory (same donation
+    # hazard as above, same ownership guarantee, per shard)
     return jax.make_array_from_callback(
         tuple(shape), sharding,
-        lambda idx: np.zeros(_shard_shape(idx, shape), dtype))
+        lambda idx: _unaliasable(
+            np.zeros(_shard_shape(idx, shape), dtype)))
 
 
 def tile_rows(mesh: Mesh, vec, rows: int) -> jax.Array:
@@ -337,18 +392,41 @@ def tile_rows(mesh: Mesh, vec, rows: int) -> jax.Array:
     shape = (rows, host.shape[0])
     sharding = NamedSharding(mesh, P(CLIENTS_AXIS, None))
     if not is_multihost():
-        # np.broadcast_to + explicit device_put — see globalize
-        return jax.device_put(np.broadcast_to(host, shape), sharding)
+        # materialize the tile ON DEVICE from the (small, explicit)
+        # device_put of the base vector: like zeros() above, the
+        # resulting block rides the round engine's donation chain, so
+        # its buffer must be XLA-allocated, never a zero-copied numpy
+        # broadcast
+        base = jax.device_put(host, NamedSharding(mesh, P()))
+        return _jit_broadcast(shape, sharding)(base)
 
     def cb(idx):
-        return np.broadcast_to(host[idx[1]],
-                               _shard_shape(idx, shape)).copy()
+        # _unaliasable: these rows ride the donation chain — see
+        # zeros() above
+        return _unaliasable(np.broadcast_to(
+            host[idx[1]], _shard_shape(idx, shape)))
 
     return jax.make_array_from_callback(shape, sharding, cb)
 
 
 def _shard_shape(idx: Tuple[slice, ...], shape: Tuple[int, ...]):
     return tuple(len(range(*s.indices(n))) for s, n in zip(idx, shape))
+
+
+def _unaliasable(arr: np.ndarray) -> np.ndarray:
+    """A copy of `arr` whose buffer device_put can NEVER zero-copy:
+    the data starts one element into an over-allocated block, so it
+    fails XLA's CPU-client alignment check and is always copied into
+    an XLA-owned device buffer. Used for host-staged state that rides
+    the round engine's donation chain on the multihost path, where
+    the jitted on-device allocation of the single-process path is
+    unavailable (the CPU backend cannot run cross-process programs).
+    If a future backend copies anyway, this is merely one redundant
+    host copy at init time."""
+    flat = np.empty(arr.size + 1, arr.dtype)
+    out = flat[1:].reshape(arr.shape)
+    out[...] = arr
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -367,6 +445,36 @@ def gather_host(x) -> np.ndarray:
         return jax.device_get(x)
     from jax.experimental import multihost_utils
     return multihost_utils.process_allgather(x, tiled=True)
+
+
+def async_gather_host(x):
+    """Begin the device->host copy of `x` WITHOUT blocking and return
+    a zero-arg completer that materializes it (an explicit
+    ``gather_host``, so a transfer-guarded caller may invoke it on any
+    thread). The tiered client-state spill path (ISSUE 11,
+    federated/statestore.py) uses this to move evicted rows off the
+    critical path: the copy is started at dispatch time and the
+    writer thread blocks on completion instead of the round loop.
+
+    The completer memoizes its result: a pending spill's rows may be
+    read back by several restores (plus the writer-thread commit)
+    before the entry retires, and each call would otherwise re-run
+    the full gather. A concurrent first call may compute twice —
+    both produce the identical host array, so the race is benign."""
+    try:
+        x.copy_to_host_async()
+    except AttributeError:
+        # host numpy value or an array type without the async API —
+        # the completer below is then the whole (cheap) copy
+        pass
+    memo = []
+
+    def complete():
+        if not memo:
+            memo.append(gather_host(x))
+        return memo[0]
+
+    return complete
 
 
 def _fully_replicated(x) -> bool:

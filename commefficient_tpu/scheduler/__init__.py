@@ -145,6 +145,15 @@ class RoundScheduler:
         self.truncated_slots = 0
         self.last_deadline_s = 0.0
         self.rounds_committed = 0
+        # working-set-aware prefetch hook (ISSUE 11): FedModel.
+        # attach_scheduler points this at the tiered state store's
+        # prefetch_host_rows when state_tier=host — commit_round then
+        # warms the HOST side of the plan's coming restores (in-flight
+        # spill materialization, disk-tail page-in) while the plan
+        # waits for dispatch. LRU-neutral by construction, so the
+        # hook's timing can never change the eviction stream or the
+        # training bits; None (the default) is a no-op.
+        self.state_prefetch = None
 
     @property
     def is_default(self) -> bool:
@@ -204,9 +213,17 @@ class RoundScheduler:
         if fresh:
             self.rounds_committed = round_idx + 1
             self.rounds_scheduled += 1
+        prefetching = self.state_prefetch is not None and fresh
+        if prefetching or not self.is_default:
+            ex = np.asarray(examples_per_slot, np.float64).reshape(-1)
+            ids = np.asarray(client_ids).reshape(-1)
+        if prefetching:
+            # tiered-state prefetch (ISSUE 11): selection runs ahead
+            # of dispatch, so the plan's cohort rows can warm on the
+            # host before their restore
+            self.state_prefetch(ids[ex > 0])
         if self.is_default:
             return
-        ex = np.asarray(examples_per_slot, np.float64).reshape(-1)
         active = ex > 0
         n_active = int(active.sum())
         if fresh:
@@ -216,7 +233,6 @@ class RoundScheduler:
         work = None
         decision = DeadlineDecision(None, None, None, None)
         if self.deadline is not None and n_active:
-            ids = np.asarray(client_ids).reshape(-1)
             decision = self.deadline.decide(ids[active], ex[active])
             if decision.work is not None:
                 work = np.ones(len(ex), np.float32)

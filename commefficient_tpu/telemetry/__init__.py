@@ -107,6 +107,10 @@ def attach_run_telemetry(model, cfg, log_dir: str, coord: bool,
         # backend ran and what dtype rode the wire
         kernel_backend=cfg.kernel_backend,
         sketch_table_dtype=cfg.sketch_table_dtype,
+        # residency provenance (ISSUE 11): a reader of state_tier
+        # events needs the tier and working-set cap in the run record
+        state_tier=cfg.state_tier,
+        state_working_set=int(cfg.state_working_set),
         scan_rounds=bool(cfg.scan_rounds),
         transfer_guard=bool(cfg.debug_transfer_guard),
         resumed_round=int(np.asarray(

@@ -34,6 +34,13 @@ consumers must tolerate kinds they don't know):
                           n_sampled, optional deadline_s /
                           est_round_s / expected_round_s /
                           truncated_slots
+  state_tier              tiered client-state residency deltas
+                          (ISSUE 11, federated/statestore): working-
+                          set hits/misses, spill/restore counts and
+                          bytes since the last record, plus resident
+                          row count and working_set size; carries
+                          `round` (per-round path) or `first_round` +
+                          `rounds` (span path)
   span                    one scanned span: first_round, rounds,
                           dispatch_s (host staging + dispatch),
                           block_s (device completion wait)
@@ -292,6 +299,10 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
       * `schedule` events carry an integer `round` and a `sampler`
         name; their optional deadline_s/est_round_s payloads are
         non-negative numbers;
+      * `state_tier` events (tiered client state, ISSUE 11) carry
+        non-negative integer hits/misses/spills/restores and
+        non-negative spill_bytes/restore_bytes/resident/working_set —
+        the residency record the BENCH_r11 working-set table reads;
       * `audit_digest` events (graftaudit cost reports) carry a
         non-empty string `digest` and a `programs` object mapping each
         audited program to non-negative numeric flops/hbm_bytes — the
@@ -352,6 +363,16 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
                     "name")
             for field in ("deadline_s", "est_round_s",
                           "expected_round_s"):
+                _comm_field(rec, n, field)
+        if rec.get("event") == "state_tier":
+            for field in ("hits", "misses", "spills", "restores"):
+                v2 = rec.get(field)
+                if not (isinstance(v2, int) and v2 >= 0):
+                    problems.append(
+                        f"record {n}: state_tier `{field}` must be a "
+                        f"non-negative integer (got {v2!r})")
+            for field in ("spill_bytes", "restore_bytes",
+                          "resident", "working_set"):
                 _comm_field(rec, n, field)
         # the two analysis-tier digest records share a shape: sha256
         # digest + per-program cost object, with tier-specific fields
@@ -444,9 +465,16 @@ def summarize(records: List[dict]) -> dict:
     span_s = ckpt_s = 0.0
     down_b = up_b = 0.0
     deadlines = 0
+    tier_hits = tier_misses = tier_spills = 0
+    tier_spill_b = 0.0
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "state_tier":
+            tier_hits += int(rec.get("hits", 0) or 0)
+            tier_misses += int(rec.get("misses", 0) or 0)
+            tier_spills += int(rec.get("spills", 0) or 0)
+            tier_spill_b += float(rec.get("spill_bytes", 0) or 0)
         if kind == "round" and isinstance(rec.get("round"), int):
             rounds.append(rec["round"])
             if isinstance(rec.get("down_bytes"), (int, float)):
@@ -460,7 +488,7 @@ def summarize(records: List[dict]) -> dict:
             ckpt_s += float(rec.get("seconds", 0.0))
         elif kind == "schedule" and rec.get("deadline_s") is not None:
             deadlines += 1
-    return {
+    out = {
         "records": len(records),
         "events": dict(sorted(kinds.items())),
         "rounds": len(rounds),
@@ -472,3 +500,11 @@ def summarize(records: List[dict]) -> dict:
         "up_mib": round(up_b / (1024 ** 2), 3),
         "deadline_rounds": deadlines,
     }
+    if tier_hits or tier_misses:
+        # tiered client state (ISSUE 11): working-set hit rate +
+        # spill traffic — the run's residency summary line
+        out["state_hit_rate"] = round(
+            tier_hits / max(tier_hits + tier_misses, 1), 4)
+        out["state_spills"] = tier_spills
+        out["state_spill_mib"] = round(tier_spill_b / (1024 ** 2), 3)
+    return out

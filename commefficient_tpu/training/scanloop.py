@@ -252,7 +252,7 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
         # throughput tracker — those commit at COLLECT time in span
         # order, so the live read at save time is the span-consistent
         # one on both paths.
-        return {
+        snap = {
             "server": model.server,
             "clients": model.clients,
             "scheduler_step": lr_scheduler.step_count,
@@ -260,6 +260,15 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
             "scheduler": model.scheduler_state(),
             "async_admit": model.async_admit_state(),
         }
+        store = getattr(model, "state_store", None)
+        if store is not None:
+            # tiered client state (ISSUE 11): the LRU/touched
+            # bookkeeping advances with the NEXT span's staging, so a
+            # one-span-late save needs the boundary-time copy — cheap
+            # host arrays; the O(working set) device gather still
+            # happens at save time, against the snapshot's block
+            snap["tier"] = store.snapshot_tier()
+        return snap
 
     def span_checkpoint(snapshot=None):
         spans_done[0] += 1
@@ -287,7 +296,8 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
             sampler=snapshot["sampler"],
             async_admit=snapshot["async_admit"],
             client_rows=model.client_rows_payload(
-                clients=snapshot["clients"]),
+                clients=snapshot["clients"],
+                tier=snapshot.get("tier")),
             writer=model.ckpt_writer)
         tele = getattr(model, "telemetry", None)
         if tele is not None:

@@ -192,6 +192,11 @@ class Checkpoint(NamedTuple):
     # checkpoint bytes scale with clients-ever-sampled, not the
     # population (FedModel.client_rows_payload / load_state). When
     # present, `clients` above is None: the two formats are exclusive.
+    # Under Config.state_tier=host (ISSUE 11) the same dict also
+    # carries `lru_ids`/`lru_slots` — the working set's recency order
+    # and slot map, drained-spill-queue consistent — so a resumed run
+    # replays the exact eviction stream; a device-tier loader ignores
+    # them (row values are tier-independent).
     client_rows: Optional[dict] = None
     # pending async-admission entries (ISSUE 10, `asyb_*` keys):
     # deferred straggler contributions not yet admitted

@@ -159,5 +159,33 @@ if [ "$rc" -eq 0 ]; then
       || { echo "POPULATION_SMOKE_FAILED"; exit 1; }
   python scripts/journal_summary.py "$JR4" \
       || { echo "POPULATION_JOURNAL_INVALID"; exit 1; }
+
+  # tiered-state smoke (ISSUE 11 satellite): the same local_topk
+  # workload behind --state_tier host with a working set SMALLER than
+  # the clients the run touches, so restores and spills happen
+  # mid-run on the bounded-queue spill writer. The journal must
+  # validate (state_tier event schema) and must show nonzero spills —
+  # a silently-inactive tier fails the gate.
+  JR6=/tmp/_t1_journal_tier.jsonl
+  rm -f "$JR6"
+  timeout -k 10 500 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode local_topk \
+      --error_type local --local_momentum 0.9 --topk_down \
+      --num_clients 100 --num_workers 8 --local_batch_size 8 \
+      --state_tier host --state_working_set 16 \
+      --num_epochs 2 --valid_batch_size 16 --lr_scale 0.1 \
+      --journal_path "$JR6" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "TIER_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR6" \
+      || { echo "TIER_JOURNAL_INVALID"; exit 1; }
+  python - "$JR6" <<'PYEOF' || { echo "TIER_NO_SPILLS"; exit 1; }
+import json, sys
+spills = sum(json.loads(l).get("spills", 0)
+             for l in open(sys.argv[1])
+             if '"state_tier"' in l)
+assert spills > 0, "tiered smoke journaled zero spills"
+PYEOF
 fi
 exit $rc
