@@ -393,6 +393,28 @@ class Config:
     # resume, never loaded across runs.
     state_spill_dir: str = ""
 
+    # coordinator-broadcast control plane (ISSUE 12,
+    # parallel/plantransport.py). "" — the default — attaches no
+    # transport: non-default scheduling stays single-controller and
+    # every code path is bit-identical to the pre-feature build.
+    # "collective" attaches the production HostCollectiveTransport
+    # (one fixed-size one-to-all host collective per round + a digest
+    # allgather): the coordinator broadcasts each round's RoundPlan,
+    # every process installs the RECEIVED plan, and Config.validate
+    # then accepts throughput sampling / deadlines / async admission
+    # in multihost runs. "emulated" replaces the run's scheduler with
+    # an in-process N-controller harness (plan_controllers lockstep
+    # controllers over an in-memory bus) — the CI surface for the
+    # fault story, since this container cannot run multi-process jax.
+    plan_transport: str = ""
+    plan_controllers: int = 2
+    # writer-thread watchdog (ISSUE 12 satellite): flush/drain timeout
+    # in seconds for the three bounded-queue writers (journal,
+    # checkpoint, state spill). 0 = wait forever (the old behavior);
+    # positive turns a hung fsync into a TimeoutError NAMING the stuck
+    # writer instead of a silent hang at crash-time drain.
+    writer_drain_timeout_s: float = 0.0
+
     # set after model construction (reference mutates args.grad_size at
     # fed_aggregator.py:88; we return a new frozen Config instead)
     grad_size: int = 0
@@ -606,16 +628,51 @@ class Config:
                 "--sampler throughput / --deadline_quantile require "
                 "telemetry (drop --no_telemetry: the session feeds "
                 "the throughput measurements these policies read)")
-        if self.multihost and (self.sampler != "uniform"
-                               or self.deadline_quantile > 0
-                               or self.target_survivors > 0):
+        if self.plan_transport not in ("", "collective", "emulated"):
+            raise ValueError(
+                f"unknown plan_transport {self.plan_transport!r} "
+                "(choices: '' — none, collective — the production "
+                "one-to-all host collective, emulated — the in-process "
+                "N-controller harness; parallel/plantransport.py)")
+        if self.plan_controllers < 1:
+            raise ValueError("plan_controllers must be >= 1")
+        if self.plan_transport == "emulated" and self.plan_controllers < 2:
+            raise ValueError(
+                "--plan_transport emulated needs --plan_controllers "
+                ">= 2 (one coordinator plus at least one follower — "
+                "a single controller has nobody to broadcast to and "
+                "would silently test nothing)")
+        if self.plan_transport and self.do_checkpoint \
+                and not self.journal_path:
+            raise ValueError(
+                "--plan_transport with --checkpoint requires an "
+                "explicit --journal_path: the write-ahead plan "
+                "journal is the authoritative decision log a "
+                "--resume takeover replays, and the default journal "
+                "location (<run dir>/journal.jsonl) is a fresh "
+                "timestamped directory each run — a resumed process "
+                "could never find the crashed run's stream and would "
+                "silently recompute (and diverge from) its durably "
+                "committed plans")
+        if self.plan_transport == "emulated" and self.multihost:
+            raise ValueError(
+                "--plan_transport emulated is the IN-PROCESS "
+                "N-controller harness (one process pretending to be "
+                "many) and cannot coexist with real multihost; use "
+                "--plan_transport collective there")
+        if (self.multihost and not self.plan_transport
+                and (self.sampler != "uniform"
+                     or self.deadline_quantile > 0
+                     or self.target_survivors > 0)):
             raise ValueError(
                 "scheduler policies (--sampler throughput / "
-                "--deadline_quantile / --target_survivors) are "
-                "single-controller only for now: decisions derive from "
+                "--deadline_quantile / --target_survivors) derive from "
                 "process-local wall-clock throughput measurements and "
-                "would diverge across controllers (coordinator-"
-                "broadcast scheduling is the named ROADMAP opening)")
+                "would diverge across controllers without a plan "
+                "transport: attach --plan_transport collective (the "
+                "coordinator broadcasts each round's RoundPlan and "
+                "every process installs the received plan — "
+                "parallel/plantransport.py)")
         if self.async_admit_rounds < 0:
             raise ValueError(
                 "async_admit_rounds must be >= 0 (0 = synchronous "
@@ -624,15 +681,27 @@ class Config:
             raise ValueError(
                 f"async_staleness_decay={self.async_staleness_decay} "
                 "must be in (0, 1] (1.0 = undiscounted late admission)")
-        if self.multihost and (self.pipeline
-                               or self.async_admit_rounds > 0):
+        if self.multihost and self.pipeline:
             raise ValueError(
-                "--pipeline / --async_admit_rounds are single-"
-                "controller only for now: the persistence writer "
-                "threads and the one-span-late commit would need "
-                "cross-process barriers, and the admit buffer holds "
-                "process-local batch rows (coordinator-broadcast "
-                "scheduling is the named ROADMAP opening)")
+                "--pipeline is single-controller only for now: the "
+                "persistence writer threads and the one-span-late "
+                "commit would need cross-process barriers (a ROADMAP "
+                "opening — the plan transport does not cover it)")
+        if (self.multihost and self.async_admit_rounds > 0
+                and not self.plan_transport):
+            raise ValueError(
+                "--async_admit_rounds needs a plan transport in "
+                "multihost runs: the defer/admit merges are control "
+                "decisions every controller must prove identical "
+                "(each process defers/admits its OWN batch rows, but "
+                "the slot/weight stream is digest-cross-checked) — "
+                "attach --plan_transport collective "
+                "(parallel/plantransport.py)")
+        if self.writer_drain_timeout_s < 0:
+            raise ValueError(
+                "writer_drain_timeout_s must be >= 0 (0 = wait "
+                "forever; positive = a hung journal/checkpoint/spill "
+                "writer drain raises TimeoutError naming the writer)")
         if self.state_tier not in ("device", "host"):
             raise ValueError(
                 f"unknown state_tier {self.state_tier!r} (choices: "
@@ -852,6 +921,29 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                         "tail with sparse f32 memmaps under this "
                         "directory (scratch state, rebuilt from "
                         "crows_* checkpoints on resume)")
+    p.add_argument("--plan_transport",
+                   choices=("", "collective", "emulated"), default="",
+                   help="coordinator-broadcast control plane (ISSUE "
+                        "12, parallel/plantransport.py): collective = "
+                        "the production one-to-all host collective "
+                        "(lifts the single-controller rejection of "
+                        "non-default schedulers / --async_admit_rounds "
+                        "in multihost runs), emulated = the in-process "
+                        "N-controller harness (--plan_controllers; "
+                        "chaos scripting via CCTPU_EMU_COORD_CRASH / "
+                        "CCTPU_EMU_COORDINATOR env vars), '' = none "
+                        "(the default — bit-identical to the "
+                        "transport-free build)")
+    p.add_argument("--plan_controllers", type=int, default=2,
+                   help="controller count of the emulated plan-"
+                        "transport harness (>= 2 when --plan_transport "
+                        "emulated)")
+    p.add_argument("--writer_drain_timeout_s", type=float, default=0.0,
+                   help="flush/drain timeout for the bounded-queue "
+                        "writer threads (journal, checkpoint, state "
+                        "spill): a hung fsync raises TimeoutError "
+                        "naming the stuck writer instead of hanging "
+                        "the crash-time drain (0 = wait forever)")
     p.add_argument("--sampler", choices=("uniform", "throughput"),
                    default="uniform",
                    help="participant-sampling policy: uniform (bit-"

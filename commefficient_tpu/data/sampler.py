@@ -256,7 +256,14 @@ class FedSampler:
         if st is not None:
             out["in_epoch"] = np.int64(1)
             out["epoch_pos"] = np.int64(st["pos"])
-            out["cursor"] = np.asarray(st["cursor"], np.int64)
+            # COPY, not view: the live epoch mutates `cursor` in place
+            # on every draw, and the pipelined span checkpoint
+            # (ISSUE 10/12) persists this capture ONE SPAN LATE — an
+            # aliased cursor would be silently advanced by the next
+            # span's draws before it hits disk, desyncing every
+            # pipelined resume (caught by test_controlplane's
+            # pipelined coordinator-crash drill)
+            out["cursor"] = np.array(st["cursor"], np.int64, copy=True)
             out["perm_flat"] = (
                 np.concatenate([np.asarray(p, np.int64)
                                 for p in st["perms"]])

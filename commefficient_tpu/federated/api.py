@@ -48,6 +48,9 @@ from commefficient_tpu.federated.async_agg import AsyncAdmitBuffer
 from commefficient_tpu.ops.flat import flatten_params
 from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
+from commefficient_tpu.parallel.plantransport import (
+    PlanDigestError, install_digest,
+)
 from commefficient_tpu.telemetry.clients import ClientThroughputTracker
 from commefficient_tpu.utils.faults import (
     FaultSchedule, InjectedFault, bernoulli_survivors,
@@ -266,6 +269,22 @@ class FedModel:
         # participations would depress the completion ratio the
         # scheduler's survival estimate reads)
         self._plan_active = {}
+        # coordinator-broadcast control plane (ISSUE 12,
+        # parallel/plantransport.py). plan_transport: the attached
+        # PlanTransport (None = transport-free, every path
+        # bit-identical to the pre-feature build). _plan_journal:
+        # consumed plans' journal fields, stashed by _faults_for_round
+        # and sealed WRITE-AHEAD by _seal_plan — the `schedule` event
+        # (with its install digest) is journaled and flushed durable
+        # BEFORE the round's dispatch, so a plan is never executed
+        # before it is durable. _replay_digests: the write-ahead plan
+        # stream of a pre-crash journal (load_plan_stream) — a
+        # deterministic restart cross-checks every replayed round's
+        # recomputed digest against it and fails loud on divergence.
+        self.plan_transport = None
+        self._plan_journal = {}
+        self._replay_digests = {}
+        self._wa_dirty = False
         # pipelined round engine (ISSUE 10): stage-side round counter
         # (runs ahead of _rounds_done when a prefetched round/span has
         # been staged but not yet committed; equal otherwise), the
@@ -286,7 +305,8 @@ class FedModel:
             from commefficient_tpu.utils.checkpoint import (
                 AsyncCheckpointWriter,
             )
-            self.ckpt_writer = AsyncCheckpointWriter()
+            self.ckpt_writer = AsyncCheckpointWriter(
+                drain_timeout=cfg.writer_drain_timeout_s)
         else:
             self.ckpt_writer = None
 
@@ -346,6 +366,99 @@ class FedModel:
         next to sampler_state()."""
         return (self.async_admit.state_dict()
                 if self.async_admit is not None else None)
+
+    def attach_transport(self, transport) -> None:
+        """Install a parallel/plantransport.PlanTransport (or None to
+        detach). With one attached, every round's control decision —
+        the post-composition cohort, survivor/work operands, and async
+        admit merges — is digested, write-ahead journaled (`schedule`
+        events gain a `digest` field, flushed durable before
+        dispatch), and cross-checked against the other controllers
+        (transport.verify); a diverged process raises PlanDigestError
+        instead of silently dispatching a different round."""
+        self.plan_transport = transport
+
+    def load_plan_stream(self, journal_path: str) -> None:
+        """Deterministic-restart hook: load the write-ahead plan
+        stream of the pre-crash run. Two halves:
+
+          * the journaled PLANS install into the scheduler
+            (load_replay_plans) — replayed rounds re-execute the
+            exact decisions the crashed run durably committed (the
+            journal is the AUTHORITATIVE decision log; recomputing a
+            throughput selection against the restored tracker would
+            diverge wherever wall-clock EMA feeds landed between the
+            checkpoint boundary and the crash);
+          * the journaled DIGESTS cross-check every replayed round's
+            recomputed install digest — a replay that still diverges
+            (differing seed/config, a non-deterministic merge) fails
+            loud (PlanDigestError) instead of silently rewriting
+            history."""
+        from commefficient_tpu.parallel.plantransport import (
+            journaled_plan_stream,
+        )
+        self._replay_digests, plans = journaled_plan_stream(
+            journal_path)
+        if plans and self.scheduler is not None and hasattr(
+                self.scheduler, "load_replay_plans"):
+            self.scheduler.load_replay_plans(plans)
+
+    def _seal_plan(self, round_idx: int, client_ids,
+                   survivors, work, admits=()) -> None:
+        """Write-ahead seal of one round's control decision (ISSUE
+        12): journal the `schedule` event (with the install digest
+        when a transport or a replay stream is live), cross-check the
+        digest against the replayed journal and the other
+        controllers. Transport-free default runs with a default
+        scheduler stash no fields and compute no digest — this is a
+        no-op there, bit-identically to the pre-feature build."""
+        fields = self._plan_journal.pop(int(round_idx), None)
+        digest = None
+        if self.plan_transport is not None or self._replay_digests:
+            digest = install_digest(round_idx, client_ids, survivors,
+                                    work, admits)
+        if self._replay_digests:
+            expect = self._replay_digests.pop(int(round_idx), None)
+            if expect is not None and expect != digest:
+                raise PlanDigestError(
+                    f"round {round_idx}: deterministic-restart replay "
+                    f"computed install digest {digest[:12]}… but the "
+                    f"write-ahead journal recorded {expect[:12]}… — "
+                    "the resumed control stream diverged from what "
+                    "the crashed run durably committed (differing "
+                    "config/seed, or a non-deterministic decision "
+                    "leaked into the plan)")
+        if self.plan_transport is not None and fields is None:
+            # a transport run journals the write-ahead stream for
+            # EVERY round (a default scheduler plans nothing, but the
+            # admit merges and fault operands are still the control
+            # decision a takeover must be able to verify)
+            ids = np.asarray(client_ids).reshape(-1)
+            fields = {"round": int(round_idx),
+                      "sampler": self.cfg.sampler,
+                      "n_sampled": int(len(ids) if survivors is None
+                                       else (np.asarray(survivors)
+                                             > 0).sum())}
+        if fields is not None and self.telemetry is not None:
+            if digest is not None:
+                fields["digest"] = digest
+            self.telemetry.journal_event("schedule", **fields)
+            if self.plan_transport is not None:
+                self._wa_dirty = True
+        if self.plan_transport is not None and digest is not None:
+            self.plan_transport.verify(round_idx, digest,
+                                       scope="install")
+
+    def _flush_write_ahead(self) -> None:
+        """Barrier the journal's writer queue so every sealed plan is
+        DURABLE before the dispatch that executes it (the write-ahead
+        contract; a no-op for the default synchronous journal, whose
+        events are durable as soon as they return, and for
+        transport-free runs)."""
+        if self._wa_dirty:
+            self._wa_dirty = False
+            if self.telemetry is not None:
+                self.telemetry.journal_flush()
 
     def drain_persistence(self) -> None:
         """Block until every queued off-critical-path checkpoint write
@@ -599,9 +712,23 @@ class FedModel:
             if plan.work is not None:
                 w = np.asarray(plan.work, np.float32)
                 work = w if work is None else np.minimum(work, w)
-            if self.telemetry is not None:
-                self.telemetry.journal_event("schedule",
-                                             **plan.journal_fields())
+            # journaling is deferred to _seal_plan (ISSUE 12): the
+            # `schedule` event must carry the digest of the FULLY
+            # composed decision (async admits land after this pass)
+            # and be durable before dispatch — write-ahead
+            fields = plan.journal_fields()
+            if self.plan_transport is not None:
+                # transport runs journal the FULL serialized plan: the
+                # journal is then the authoritative decision log a
+                # deterministic restart REPLAYS (scheduler.
+                # load_replay_plans installs these bytes for replayed
+                # rounds instead of recomputing decisions against a
+                # wall-clock-fed tracker the replay cannot reproduce)
+                from commefficient_tpu.parallel.plantransport import (
+                    serialize_plan,
+                )
+                fields["plan"] = serialize_plan(plan).decode()
+            self._plan_journal[int(round_idx)] = fields
         if work is not None:
             work = np.asarray(work, np.float32)
             cutoff = self.cfg.straggler_cutoff
@@ -841,6 +968,7 @@ class FedModel:
             self._journal_fault("crash_in_span", this_round - 1)
             raise InjectedFault(this_round - 1)
         survivors, work = self._faults_for_round(this_round, client_ids)
+        admits = ()
         if self.async_admit is not None:
             # buffered async aggregation (federated/async_agg): defer
             # this round's stragglers onto the dropped-client path and
@@ -848,6 +976,16 @@ class FedModel:
             (client_ids, data, mask, survivors,
              work) = self.async_admit.compose(
                 this_round, client_ids, data, mask, survivors, work)
+            admits = self.async_admit.last_admits
+        # write-ahead plan seal (ISSUE 12): digest + journal the
+        # composed control decision, flush it durable before this
+        # round's dispatch, and cross-check against the other
+        # controllers / the replayed journal. No-op without a
+        # transport or replay stream (beyond the journaling the
+        # scheduler always got).
+        self._seal_plan(this_round, client_ids, survivors, work,
+                        admits)
+        self._flush_write_ahead()
 
         # tiered client state (ISSUE 11): assign device slots AFTER
         # admission composition (an admitted client needs a slot too).
@@ -1067,11 +1205,14 @@ class FedModel:
         if (self.cfg.client_dropout > 0 or self.cfg.straggler_rate > 0
                 or self.fault_schedule is not None
                 or self._scheduler_active()
-                or self.async_admit is not None):
+                or self.async_admit is not None
+                or self.plan_transport is not None
+                or self._replay_digests):
             copied = False
             rows = []
             for n in range(n_rounds):
                 s, w = self._faults_for_round(first + n, ids_host[n])
+                admits = ()
                 if self.async_admit is not None:
                     row_ids = ids_host[n]
                     row_data = tuple(np.asarray(d)[n] for d in data)
@@ -1080,6 +1221,7 @@ class FedModel:
                         self.async_admit.compose(
                             first + n, row_ids, row_data, row_mask,
                             s, w)
+                    admits = self.async_admit.last_admits
                     if ids_n is not row_ids:
                         # an admission rewrote this round's cohort
                         # rows — copy the span containers LAZILY (the
@@ -1097,6 +1239,10 @@ class FedModel:
                         for d, d_n in zip(data, data_n):
                             d[n] = d_n
                         mask[n] = mask_n
+                # write-ahead seal per round (ISSUE 12): the whole
+                # span's sealed records flush as one barrier below,
+                # still BEFORE the span's dispatch
+                self._seal_plan(first + n, ids_host[n], s, w, admits)
                 rows.append((s, w))
             ones = np.ones(ids_host.shape[1], np.float32)
             if any(w is not None for _, w in rows):
@@ -1191,6 +1337,9 @@ class FedModel:
                         return False
             return True
 
+        # write-ahead barrier (ISSUE 12): every sealed plan of this
+        # span must be durable before the span executes
+        self._flush_write_ahead()
         t_dispatch0 = time.monotonic()
         self.server, self.clients, metrics, bits = with_retries(
             dispatch, describe="scanned round span",

@@ -78,6 +78,14 @@ class AsyncAdmitBuffer:
         self.delay = int(delay)
         self.decay = float(decay)
         self._pending: List[_PendingEntry] = []
+        # the admit merges applied by the LAST compose() call, as
+        # (slot, client_id, effective_work_fraction, origin_round)
+        # tuples — the plan-carried form of the admission stream
+        # (ISSUE 12): FedModel folds these into the round's install
+        # digest so every controller proves it merged the identical
+        # late contributions, and a deterministic restart can verify
+        # its replayed admissions against the write-ahead journal.
+        self.last_admits: List[Tuple[int, int, float, int]] = []
 
     # ---------------- the math -------------------------------------------
     def staleness_weight(self, rounds_late: int) -> np.float32:
@@ -105,6 +113,7 @@ class AsyncAdmitBuffer:
         async-off-equivalent rounds stay on the exact operands (and
         therefore programs) a buffer-free build dispatches."""
         round_idx = int(round_idx)
+        self.last_admits = []
         due = [e for e in self._pending if e.due <= round_idx]
         if work is None and not due:
             return client_ids, data, mask, survivors, work
@@ -153,6 +162,9 @@ class AsyncAdmitBuffer:
                 surv_arr[slot] = 1.0
                 work_arr[slot] = e.frac * self.staleness_weight(
                     round_idx - e.origin)
+                self.last_admits.append(
+                    (slot, int(e.client_id), float(work_arr[slot]),
+                     int(e.origin)))
             changed = True
 
         if not changed:

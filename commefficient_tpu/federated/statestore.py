@@ -55,6 +55,7 @@ contract).
 """
 from __future__ import annotations
 
+import errno
 import os
 import threading
 from collections import OrderedDict, deque
@@ -96,19 +97,25 @@ class TierPlan(NamedTuple):
     spills: Tuple[Tuple[int, int], ...]    # (victim_id, slot) evictions
 
 
-def _make_spill_writer(max_pending: int = 4):
+def _make_spill_writer(max_pending: int = 4,
+                       drain_timeout: float = 0.0):
     """The spill queue IS utils/checkpoint.AsyncCheckpointWriter — the
     ISSUE-10 bounded-queue FIFO thread with deferred re-raise at
     submit()/drain(), exactly the contract a correctness-critical
     spill needs (a failed spill LOSES CLIENT STATE, so it must not be
-    best-effort like the journal writer). Imported lazily: at module
-    scope, importing utils.checkpoint from here would re-enter a
-    partially-initialized checkpoint module whenever checkpoint itself
-    is the import root (checkpoint -> federated package -> api -> this
-    module -> checkpoint); by store-construction time every module is
-    fully initialized."""
+    best-effort like the journal writer). `drain_timeout` is the
+    ISSUE-12 watchdog (Config.writer_drain_timeout_s): a hung spill
+    fsync raises TimeoutError naming the state-spill writer instead
+    of silently hanging flush()/checkpoint drains. Imported lazily: at
+    module scope, importing utils.checkpoint from here would re-enter
+    a partially-initialized checkpoint module whenever checkpoint
+    itself is the import root (checkpoint -> federated package -> api
+    -> this module -> checkpoint); by store-construction time every
+    module is fully initialized."""
     from commefficient_tpu.utils.checkpoint import AsyncCheckpointWriter
-    return AsyncCheckpointWriter(max_pending=max_pending)
+    return AsyncCheckpointWriter(max_pending=max_pending,
+                                 drain_timeout=drain_timeout,
+                                 name="state-spill")
 
 
 class _RamTail:
@@ -182,20 +189,49 @@ class _DiskTail:
 
     def __init__(self, dirpath: str, fields: List[str],
                  num_clients: int, D: int):
-        os.makedirs(dirpath, exist_ok=True)
+        self._dir = str(dirpath)
         self._fields = list(fields)
         self._present: set = set()
         self._maps: Dict[str, np.ndarray] = {}
-        for f in fields:
-            path = os.path.join(dirpath, f"tail_{f}.npy")
-            self._maps[f] = np.lib.format.open_memmap(
-                path, mode="w+", dtype=np.float32,
-                shape=(int(num_clients), int(D)))
+        try:
+            os.makedirs(dirpath, exist_ok=True)
+            for f in fields:
+                path = os.path.join(dirpath, f"tail_{f}.npy")
+                self._maps[f] = np.lib.format.open_memmap(
+                    path, mode="w+", dtype=np.float32,
+                    shape=(int(num_clients), int(D)))
+        except OSError as e:
+            raise self._spill_error(e) from e
+
+    def _spill_error(self, e: OSError) -> OSError:
+        """Disk-full/IO failure on the spill tail, made actionable
+        (ISSUE 12 satellite): spills are CORRECTNESS — a lost spill
+        is lost client state — so the error must fail loud and name
+        the knob, not surface as a bare errno from inside numpy."""
+        why = ("disk full (ENOSPC)" if e.errno == errno.ENOSPC
+               else f"{type(e).__name__}: {e}")
+        return OSError(
+            e.errno or errno.EIO,
+            f"state spill write under --state_spill_dir "
+            f"{self._dir!r} failed: {why}. Spilled rows are the "
+            "authoritative copy of evicted client state — free space "
+            "on (or relocate) --state_spill_dir, or drop the flag to "
+            "keep the tail in host RAM.")
 
     def put(self, ids, rows: Dict[str, np.ndarray]) -> None:
+        # Residual risk: these are stores into SPARSE memmap pages, so
+        # a filesystem that fills up mid-run can deliver the
+        # allocation failure as SIGBUS on first-touch (uncatchable)
+        # rather than an OSError — the actionable message below covers
+        # creation, flush, and whatever the kernel does surface as
+        # errno. Preallocating would close that hole but defeats the
+        # sparse tail (disk O(touched rows), the point of this class).
         idx = np.asarray(ids, np.int64)
-        for f in self._fields:
-            self._maps[f][idx] = rows[f][:len(idx)]
+        try:
+            for f in self._fields:
+                self._maps[f][idx] = rows[f][:len(idx)]
+        except OSError as e:
+            raise self._spill_error(e) from e
         self._present.update(int(c) for c in idx)
 
     def has(self, cid: int) -> bool:
@@ -220,8 +256,11 @@ class _DiskTail:
         self._present.clear()
 
     def close(self) -> None:
-        for m in self._maps.values():
-            m.flush()
+        try:
+            for m in self._maps.values():
+                m.flush()
+        except OSError as e:
+            raise self._spill_error(e) from e
 
 
 class TieredStateStore:
@@ -255,7 +294,9 @@ class TieredStateStore:
         # tail (the lock covers tail + pending, both threads touch)
         self._pending: Dict[int, Tuple[dict, int]] = {}
         self._lock = threading.Lock()
-        self._writer = _make_spill_writer()
+        self._writer = _make_spill_writer(
+            drain_timeout=float(getattr(cfg, "writer_drain_timeout_s",
+                                        0.0)))
         # scheduler prefetch cache (working-set-aware prefetch of the
         # next plan's cohort): host rows warmed ahead of their restore
         # — LRU-NEUTRAL by construction, so prefetch timing can never

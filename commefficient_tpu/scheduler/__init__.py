@@ -47,10 +47,15 @@ Invariants:
     tests/test_sampler_resume.py. Legacy checkpoints without smp_*
     keep the replay fast-forward path (bit-exact for uniform, the
     default).
-  * SINGLE-CONTROLLER ONLY for non-default policies: tracker rates
-    derive from process-local wall clocks and would diverge across
-    controllers (Config.validate rejects the combination; the
-    coordinator-broadcast path is a named ROADMAP opening).
+  * SINGLE-CONTROLLER ONLY for non-default policies — UNLESS a plan
+    transport is attached (ISSUE 12, parallel/plantransport.py): the
+    coordinator computes each round's plan from its process-local
+    tracker, broadcasts the serialized RoundPlan once per round, and
+    EVERY controller (coordinator included) installs the *received*
+    plan through the identical `_install` path, so decisions no
+    longer depend on any process's local clock. Config.validate
+    accepts throughput sampling / deadlines / async admission under
+    `--plan_transport`; without one the old rejection stands.
 """
 from __future__ import annotations
 
@@ -93,6 +98,12 @@ class RoundPlan(NamedTuple):
     est_round_s: Optional[float]
     expected_round_s: Optional[float]
     sampler: str
+    # the CHOSEN participant ids, pre-padding (ISSUE 12): a broadcast
+    # plan must carry the selection itself so a follower controller
+    # under a process-local policy (throughput) installs the
+    # coordinator's draw instead of consulting its own tracker. None
+    # on transport-free plans — nothing downstream reads it there.
+    participants: Optional[np.ndarray] = None
 
     def journal_fields(self) -> dict:
         """Payload of the `schedule` journal event (None fields
@@ -154,6 +165,90 @@ class RoundScheduler:
         # hook's timing can never change the eviction stream or the
         # training bits; None (the default) is a no-op.
         self.state_prefetch = None
+        # coordinator-broadcast control plane (ISSUE 12,
+        # parallel/plantransport.py): None keeps every path identical
+        # to the transport-free build. With a transport attached the
+        # coordinator broadcasts each round's serialized plan at
+        # commit_round and EVERY controller (coordinator included)
+        # installs the round-tripped bytes through the same code path;
+        # follower controllers take the process-local decisions
+        # (throughput selection, deadlines) from the broadcast instead
+        # of their own tracker.
+        self.transport = None
+        self._last_selected: Optional[np.ndarray] = None
+        self._received: Optional[RoundPlan] = None
+        # deterministic-restart replay (ISSUE 12): {round: serialized
+        # plan bytes} from the pre-crash run's write-ahead journal
+        # (plantransport.journaled_plans, wired by FedModel.
+        # load_plan_stream). A replayed round INSTALLS these bytes —
+        # selection, work fractions, deadlines — and the (possibly
+        # promoted) coordinator REBROADCASTS them verbatim, instead
+        # of recomputing decisions against the restored tracker: the
+        # journal is the authoritative decision log, and a
+        # recomputed throughput selection would diverge wherever
+        # wall-clock EMA feeds landed between the checkpoint
+        # boundary and the crash.
+        self.replay_plans: Dict[int, bytes] = {}
+
+    def load_replay_plans(self, plans: Dict[int, bytes]) -> None:
+        """Install a pre-crash run's journaled plan stream for the
+        deterministic-restart replay (see replay_plans above)."""
+        self.replay_plans = dict(plans)
+
+    def attach_transport(self, transport) -> None:
+        """Install a parallel/plantransport.PlanTransport (or None to
+        detach). Only matters for non-default policies — the default
+        scheduler plans nothing, so there is nothing to broadcast and
+        every controller already draws the identical uniform stream."""
+        self.transport = transport
+
+    @property
+    def _follower(self) -> bool:
+        """True when this controller must INSTALL broadcast plans
+        rather than compute them: a transport is attached, the policy
+        set is non-default, and this process is not the coordinator."""
+        return (self.transport is not None and not self.is_default
+                and not self.transport.is_coordinator)
+
+    def _recv_plan(self, round_idx: int) -> RoundPlan:
+        """Follower receive: block (with retries) until the
+        coordinator's broadcast for `round_idx` lands, and install the
+        delivered bytes. Idempotent — a duplicated delivery installs
+        the same plan under the same round key."""
+        from commefficient_tpu.parallel.plantransport import (
+            deserialize_plan,
+        )
+        plan = deserialize_plan(self.transport.broadcast(round_idx))
+        self._received = plan
+        return plan
+
+    def _selection_from_plan(self, plan: RoundPlan, alive, rng,
+                             source: str, diverged: str) -> np.ndarray:
+        """This round's participants, taken from an installed plan
+        (broadcast or journaled replay) instead of a local decision.
+        A shared-stream policy (uniform) still draws locally — the
+        replicated rng must advance identically on every controller —
+        and the local draw is cross-checked against the plan, failing
+        loud on divergence instead of silently desyncing the data
+        stream."""
+        from commefficient_tpu.parallel.plantransport import (
+            PlanDigestError,
+        )
+        if plan.participants is None:
+            raise PlanDigestError(
+                f"round {self._next_round}: {source} carries no "
+                "participants — coordinator running a pre-transport "
+                "build?")
+        part = np.asarray(plan.participants)
+        if not self.policy.process_local:
+            mine = np.asarray(self.policy.select(
+                np.asarray(alive), len(part), rng, self._next_round))
+            if not np.array_equal(mine, part):
+                raise PlanDigestError(
+                    f"round {self._next_round}: this controller's "
+                    f"shared-stream draw disagrees with {source} — "
+                    f"{diverged}")
+        return part
 
     @property
     def is_default(self) -> bool:
@@ -174,6 +269,8 @@ class RoundScheduler:
         abandoned stream tail are dropped."""
         self._next_round = int(first_round)
         self._plans.clear()
+        self._last_selected = None
+        self._received = None
 
     def select(self, alive: np.ndarray, num_slots: int,
                rng) -> np.ndarray:
@@ -181,12 +278,50 @@ class RoundScheduler:
         picks the count, the policy picks the identities. Returns
         n <= num_slots distinct ids; the FedSampler pads the remaining
         slots with idle (zero-mask) rows that commit_round marks
-        dead."""
+        dead.
+
+        FOLLOWER controllers (transport attached, non-coordinator)
+        never consult their local tracker: the broadcast plan carries
+        the coordinator's chosen participants AND their count (the
+        over-provisioning arithmetic reads the coordinator's survival
+        estimate, which is process-local too). A shared-stream policy
+        (uniform) still draws locally from the replicated rng — the
+        draw is a pure function of the shared stream, it must advance
+        identically on every controller — and the local draw is
+        cross-checked against the broadcast, failing loud on
+        divergence instead of silently desyncing the data stream."""
+        if self._follower:
+            plan = self._recv_plan(self._next_round)
+            return self._selection_from_plan(
+                plan, alive, rng, source="the coordinator's broadcast",
+                diverged="rng replicas diverged")
+        wire = (self.replay_plans.get(self._next_round)
+                if self.transport is not None else None)
+        if wire is not None:
+            # deterministic-restart replay: the journaled plan's
+            # participants ARE this round's selection. A shared-stream
+            # policy still draws locally (the replicated rng must
+            # advance identically) and cross-checks against the log.
+            from commefficient_tpu.parallel.plantransport import (
+                deserialize_plan,
+            )
+            part = self._selection_from_plan(
+                deserialize_plan(wire), alive, rng,
+                source="the write-ahead journaled plan",
+                diverged="restored rng state diverged from the "
+                         "crashed run")
+            self._last_selected = np.array(part, copy=True)
+            return part
         n = overprovision(self.target_survivors, int(num_slots),
                           len(alive), self._survival_estimate())
-        return np.asarray(
+        chosen = np.asarray(
             self.policy.select(np.asarray(alive), n, rng,
                                self._next_round))
+        if self.transport is not None:
+            # stashed for the broadcast plan (commit_round): the plan
+            # must carry the selection itself
+            self._last_selected = np.array(chosen, copy=True)
+        return chosen
 
     def _survival_estimate(self) -> float:
         """Expected fraction of sampled clients that complete a round:
@@ -224,6 +359,33 @@ class RoundScheduler:
             self.state_prefetch(ids[ex > 0])
         if self.is_default:
             return
+        if self._follower:
+            # install the broadcast plan — NEVER this controller's
+            # local computation (its tracker is process-local state
+            # the coordinator's decision must not depend on). select
+            # already received it; a commit without a prior select
+            # (defensive) re-receives, which is idempotent.
+            plan = self._received
+            if plan is None or plan.round_idx != round_idx:
+                plan = self._recv_plan(round_idx)
+            self._received = None
+            self._install(round_idx, plan, fresh)
+            return
+        wire = (self.replay_plans.pop(round_idx, None)
+                if self.transport is not None else None)
+        if wire is not None:
+            # deterministic-restart replay, coordinator side: install
+            # AND REBROADCAST the journaled bytes verbatim — the
+            # followers of the resumed fleet receive exactly what the
+            # crashed run durably committed
+            from commefficient_tpu.parallel.plantransport import (
+                deserialize_plan,
+            )
+            self._last_selected = None
+            delivered = self.transport.broadcast(round_idx, wire)
+            self._install(round_idx, deserialize_plan(delivered),
+                          fresh)
+            return
         active = ex > 0
         n_active = int(active.sum())
         if fresh:
@@ -243,10 +405,50 @@ class RoundScheduler:
             if decision.deadline_s is not None and fresh:
                 self.deadline_rounds += 1
                 self.last_deadline_s = float(decision.deadline_s)
-        self._plans[round_idx] = RoundPlan(
+        plan = RoundPlan(
             round_idx, n_active, active_mask, work,
             decision.deadline_s, decision.est_round_s,
-            decision.expected_round_s, self.policy.name)
+            decision.expected_round_s, self.policy.name,
+            self._last_selected if self.transport is not None
+            else None)
+        self._last_selected = None
+        if self.transport is not None:
+            # coordinator broadcast: serialize, send once, and install
+            # the DELIVERED bytes — the identical code path a follower
+            # runs, so a serialization bug cannot split the fleet into
+            # a coordinator executing one plan and followers another
+            from commefficient_tpu.parallel.plantransport import (
+                deserialize_plan, serialize_plan,
+            )
+            delivered = self.transport.broadcast(
+                round_idx, serialize_plan(plan))
+            self._install(round_idx, deserialize_plan(delivered),
+                          fresh=False)
+            return
+        self._plans[round_idx] = plan
+
+    def _install(self, round_idx: int, plan: RoundPlan,
+                 fresh: bool) -> None:
+        """Install one broadcast-received plan: store it for
+        take_plan, advance follower counters from ITS fields (a
+        follower never ran the local deadline computation), and
+        cross-check the installed bytes against every other
+        controller's (transport.verify — PlanDigestError on
+        divergence)."""
+        from commefficient_tpu.parallel.plantransport import plan_digest
+        if fresh:
+            # coordinator counters advanced during local computation;
+            # follower counters derive from the installed plan so the
+            # persisted sched_* stream is identical on every controller
+            self.clients_sampled += int(plan.n_sampled)
+            if plan.work is not None:
+                self.truncated_slots += int(
+                    (np.asarray(plan.work) < 1.0).sum())
+            if plan.deadline_s is not None:
+                self.deadline_rounds += 1
+                self.last_deadline_s = float(plan.deadline_s)
+        self._plans[round_idx] = plan
+        self.transport.verify(round_idx, plan_digest(plan))
 
     # ---------------- dispatch side (FedModel) ---------------------------
     def take_plan(self, round_idx: int) -> Optional[RoundPlan]:

@@ -57,6 +57,14 @@ class ParticipantSampler:
     """
 
     name = "?"
+    # PROCESS-LOCAL policies read state only the coordinator holds
+    # live (tracker EMAs) — under a plan transport (ISSUE 12,
+    # parallel/plantransport.py) a follower controller must install
+    # the coordinator's broadcast participants instead of drawing
+    # locally. Shared-stream policies (uniform) draw identically on
+    # every controller from the replicated FedSampler rng, so
+    # followers draw locally AND cross-check against the broadcast.
+    process_local = False
 
     def select(self, alive: np.ndarray, num_slots: int, rng,
                round_idx: int) -> np.ndarray:
@@ -171,6 +179,7 @@ class ThroughputAwareSampler(ParticipantSampler):
     """
 
     name = "throughput"
+    process_local = True  # reads the coordinator's live tracker
 
     def __init__(self, seed: int, tracker: ClientThroughputTracker,
                  explore_floor: float = 0.1, speed_bias: float = 2.0,

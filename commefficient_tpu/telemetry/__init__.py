@@ -91,7 +91,9 @@ def attach_run_telemetry(model, cfg, log_dir: str, coord: bool,
         # fsync per queued batch, drained on close/crash) so journal
         # durability leaves the round loop's critical path
         journal = RunJournal(jpath, run_id=log_dir or driver,
-                             async_writer=bool(cfg.pipeline))
+                             async_writer=bool(cfg.pipeline),
+                             drain_timeout=float(getattr(
+                                 cfg, "writer_drain_timeout_s", 0.0)))
     tele = TelemetrySession(
         journal=journal, tracker=model.throughput,
         profile_spans=cfg.profile_spans,
@@ -280,6 +282,18 @@ class TelemetrySession:
         prev, self._pending = self._pending, None
         if prev is not None:
             self._emit_round(prev, None)
+        if self.journal is not None:
+            self._safe_write(self.journal.flush)
+
+    def journal_flush(self) -> None:
+        """Barrier ONLY the journal's async writer queue, leaving the
+        one-round-lag metric buffer alone (draining it here would
+        journal the pending round without an interval measurement and
+        skip its tracker feeding). The write-ahead plan seal (ISSUE
+        12, FedModel._flush_write_ahead) needs exactly this: sealed
+        `schedule` records durable before dispatch, telemetry
+        semantics untouched. A no-op for the default synchronous
+        journal, whose events are durable when event() returns."""
         if self.journal is not None:
             self._safe_write(self.journal.flush)
 

@@ -148,10 +148,14 @@ class RunJournal:
 
     def __init__(self, path: str, run_id: str = "",
                  clock: Callable[[], float] = time.time,
-                 async_writer: bool = False, max_queue: int = 256):
+                 async_writer: bool = False, max_queue: int = 256,
+                 drain_timeout: float = 0.0):
         self.path = path
         self.run_id = run_id
         self._clock = clock
+        # writer-thread watchdog (ISSUE 12 satellite): flush()/close()
+        # deadline in seconds; 0 = wait forever (the old behavior)
+        self._drain_timeout = float(drain_timeout)
         # a torn tail can only predate this writer's first append —
         # seal-check once, then skip the per-record read
         self._tail_checked = False
@@ -229,17 +233,22 @@ class RunJournal:
         """Block until every queued record is durable (async mode); a
         no-op in synchronous mode, where `event` already fsynced. The
         crash-boundary writers (FedModel._journal_fault) call this so
-        an injected_fault record is on disk before the raise."""
+        an injected_fault record is on disk before the raise. With a
+        `drain_timeout`, a hung writer raises TimeoutError naming the
+        journal (utils/watchdog) instead of hanging the caller."""
         if self._q is not None:
-            self._q.join()
+            from commefficient_tpu.utils.watchdog import drain_queue
+            drain_queue(self._q, self._drain_timeout, "journal")
 
     def close(self) -> None:
         """Drain and stop the writer thread (async mode); in sync mode
         there is no buffered state — kept so callers can treat the
-        journal like a file handle. Idempotent."""
+        journal like a file handle. Idempotent. Honors the
+        drain_timeout watchdog like flush()."""
         if self._q is not None:
+            from commefficient_tpu.utils.watchdog import drain_queue
             q, self._q = self._q, None
-            q.join()
+            drain_queue(q, self._drain_timeout, "journal")
             q.put(self._SENTINEL)
             self._thread.join()
             self._thread = None
@@ -253,34 +262,62 @@ def append_event(path: str, kind: str, **fields) -> dict:
 
 # ---------------- reading + invariant validation -------------------------
 
-def read_journal(path: str) -> Tuple[List[dict], List[str]]:
+def read_journal(path: str,
+                 counters: Optional[dict] = None
+                 ) -> Tuple[List[dict], List[str]]:
     """Parse a journal file. Returns (records, problems): records are
     the successfully parsed lines in order; problems are human-readable
-    descriptions of malformed lines. A torn FINAL line (the one shape a
-    preemption mid-append can produce) is reported as a problem but
-    does not invalidate the committed records before it."""
+    descriptions of malformed lines that invalidate the journal.
+
+    Corruption tolerance (ISSUE 12 satellite): a torn FINAL line (the
+    shape a preemption mid-append produces) is reported as a problem
+    but does not invalidate the committed records before it — the
+    original contract. Corrupt INTERIOR lines — possible since the
+    PR-10 async batch writer can die mid-batch, and a sealed torn tail
+    becomes interior once a resumed run appends past it — are SKIPPED
+    AND COUNTED rather than treated as validation failures: every
+    parseable record still reads, and the count is surfaced through
+    `counters` (key "corrupt_interior", plus "corrupt_lines" detailing
+    line numbers) so `summarize()` can report it. Pass a dict as
+    `counters` to receive the counts; the (records, problems) return
+    shape is unchanged for the many existing callers."""
     records: List[dict] = []
     problems: List[str] = []
+    skipped: List[int] = []
     with open(path) as f:
         lines = f.read().splitlines()
+
+    def _skip_or_problem(i: int, desc: str) -> None:
+        if i == len(lines):
+            # the final line: the one torn shape a clean-history
+            # journal can have — report it, the committed prefix
+            # stands
+            problems.append(f"line {i}: {desc} (torn tail?)")
+        else:
+            skipped.append(i)
+
     for i, line in enumerate(lines, 1):
         if not line.strip():
-            problems.append(f"line {i}: blank line")
+            _skip_or_problem(i, "blank line")
             continue
         try:
             rec = json.loads(line)
         except ValueError:
-            tag = " (torn tail?)" if i == len(lines) else ""
-            problems.append(f"line {i}: not valid JSON{tag}")
+            _skip_or_problem(i, "not valid JSON")
             continue
         if not isinstance(rec, dict):
-            problems.append(f"line {i}: not a JSON object")
+            _skip_or_problem(i, "not a JSON object")
             continue
         records.append(rec)
+    if counters is not None:
+        counters["corrupt_interior"] = len(skipped)
+        counters["corrupt_lines"] = list(skipped)
     return records, problems
 
 
-def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
+def validate_journal(path: str,
+                     counters: Optional[dict] = None
+                     ) -> Tuple[List[dict], List[str]]:
     """Journal invariants as a checkable function (shared by
     scripts/journal_summary.py and tests/test_telemetry.py):
 
@@ -319,9 +356,13 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
     (its run_start carries `resumed_round`), so cross-segment repeats
     are healthy history, not violations.
 
+    Corrupt INTERIOR lines are skipped-and-counted, not violations
+    (read_journal; the PR-10 async batch writer can die mid-batch) —
+    pass a `counters` dict to receive the count for summarize().
+
     Returns (records, problems); an empty problems list means the
     journal is valid."""
-    records, problems = read_journal(path)
+    records, problems = read_journal(path, counters=counters)
     seen_rounds = set()
     last_round = None
     seg_down = seg_up = 0.0
@@ -457,9 +498,14 @@ def validate_journal(path: str) -> Tuple[List[dict], List[str]]:
     return records, problems
 
 
-def summarize(records: List[dict]) -> dict:
+def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     """Small host-side digest of a journal: event-kind counts, round
-    coverage, total journaled wall time in spans/checkpoints."""
+    coverage, total journaled wall time in spans/checkpoints.
+    `corrupt_lines`: the skipped-interior-line count from
+    read_journal/validate_journal's `counters` — surfaced in the
+    summary (ISSUE 12 satellite) so a journal that survived a
+    mid-batch writer crash says so instead of silently looking
+    clean."""
     kinds: dict = {}
     rounds = []
     span_s = ckpt_s = 0.0
@@ -507,4 +553,6 @@ def summarize(records: List[dict]) -> dict:
             tier_hits / max(tier_hits + tier_misses, 1), 4)
         out["state_spills"] = tier_spills
         out["state_spill_mib"] = round(tier_spill_b / (1024 ** 2), 3)
+    if corrupt_lines:
+        out["corrupt_lines"] = int(corrupt_lines)
     return out

@@ -43,8 +43,8 @@ from commefficient_tpu.training.scanloop import (
     make_span_checkpoint, run_scanned_rounds,
 )
 from commefficient_tpu.utils.checkpoint import (
-    latest_checkpoint_path, load_checkpoint, save_final, save_rotating,
-    transfer_for_finetune,
+    latest_checkpoint_path, load_checkpoint, load_resilient,
+    save_final, save_rotating, transfer_for_finetune,
 )
 from commefficient_tpu.utils.logging import (
     TableLogger, Timer, make_logdir,
@@ -530,6 +530,17 @@ def main(argv=None) -> bool:
     from commefficient_tpu.scheduler import attach_round_scheduler
     attach_round_scheduler(model, train_loader)
 
+    # coordinator-broadcast control plane (ISSUE 12): attach the
+    # configured plan transport — "collective" wires the production
+    # one-to-all host broadcast onto the scheduler above, "emulated"
+    # replaces it with the in-process N-controller harness (the CI
+    # fault surface). Attached BEFORE --resume like the scheduler, so
+    # restored sched_* counters land in every controller replica.
+    from commefficient_tpu.parallel.plantransport import (
+        attach_config_transport,
+    )
+    attach_config_transport(model, train_loader, cfg)
+
     if mh.is_multihost():
         # per-process batch feeding — or, on non-contiguous layouts,
         # the globalize() fallback (one shared implementation:
@@ -538,19 +549,32 @@ def main(argv=None) -> bool:
                              cfg.num_workers, val_loader.num_shards)
 
     sched_step = 0
+    ckpt_fallbacks = []
     if cfg.resume:
-        # auto-resume-from-latest: the newest rotated checkpoint via
-        # the manifest, falling back to the legacy fixed-name file;
-        # fingerprint-validated so a wrong checkpoint dir fails with
-        # the offending field named, not a broadcast error
-        ck_file = latest_checkpoint_path(_ckpt_path(cfg))
-        if ck_file is not None:
-            ckpt = load_checkpoint(
-                ck_file, expect_fingerprint=model.checkpoint_fingerprint)
+        # auto-resume-from-latest, corruption-tolerant (ISSUE 12
+        # satellite): integrity-check the newest rotated checkpoint
+        # against the manifest's per-array checksums and FALL BACK to
+        # the previous keep-last-k rotation when it is corrupt or
+        # truncated, instead of crashing mid-resume; each skipped file
+        # is journaled as a loud `checkpoint_fallback` event once the
+        # telemetry session exists. Fingerprint-validated so a wrong
+        # checkpoint dir still fails with the offending field named.
+        loaded = load_resilient(
+            _ckpt_path(cfg),
+            expect_fingerprint=model.checkpoint_fingerprint,
+            on_fallback=lambda p, why: ckpt_fallbacks.append((p, why)))
+        if loaded is not None:
+            ck_file, ckpt = loaded
             sched_step = model.load_state(ckpt)
             if mh.is_coordinator():
                 print(f"resumed from {ck_file} at round "
                       f"{int(ckpt.server.round_idx)}")
+        if model.plan_transport is not None and cfg.journal_path:
+            # deterministic restart (ISSUE 12): load the pre-crash
+            # run's write-ahead plan stream — replayed rounds must
+            # recompute the identical install digests, or the resume
+            # fails loud instead of silently rewriting history
+            model.load_plan_stream(cfg.journal_path)
 
     # LR schedule (reference cv_train.py:392-404; cifar10-fast default
     # knots [0, pivot, num_epochs] -> [0, lr_scale, 0])
@@ -568,6 +592,12 @@ def main(argv=None) -> bool:
     tele = attach_run_telemetry(model, cfg, log_dir, coord,
                                 driver="cv_train",
                                 materialize=mh.gather_host)
+    if tele is not None:
+        # resume-time integrity fallbacks, journaled now that the
+        # session exists (the resume ran before telemetry attach)
+        for p, why in ckpt_fallbacks:
+            tele.journal_event("checkpoint_fallback", path=p,
+                               error=why[:200])
     if coord:
         print(f"Finished initializing in {timer():.2f} seconds")
 

@@ -48,8 +48,7 @@ from commefficient_tpu.training.scanloop import (
 )
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.utils.checkpoint import (
-    latest_checkpoint_path, load_checkpoint, save_checkpoint,
-    save_final, save_rotating,
+    save_checkpoint, save_final, save_rotating,
 )
 from commefficient_tpu.utils.logging import (
     NullLogger, TableLogger, Timer, make_logdir,
@@ -528,6 +527,14 @@ def main(argv=None) -> bool:
     from commefficient_tpu.scheduler import attach_round_scheduler
     attach_round_scheduler(model, train_loader)
 
+    # coordinator-broadcast control plane (ISSUE 12): the configured
+    # plan transport rides on the scheduler above — wiring shared
+    # with cv_train (parallel/plantransport.attach_config_transport)
+    from commefficient_tpu.parallel.plantransport import (
+        attach_config_transport,
+    )
+    attach_config_transport(model, train_loader, cfg)
+
     coord = mh.is_coordinator()
     if mh.is_multihost():
         # per-process batch feeding — or, on non-contiguous layouts,
@@ -547,16 +554,29 @@ def main(argv=None) -> bool:
     # checkpoint via the manifest, legacy fixed-name fallback,
     # fingerprint-validated (utils/checkpoint)
     ckpt_path = os.path.join(cfg.checkpoint_path, "gpt2")
+    ckpt_fallbacks = []
     if cfg.resume:
-        ck_file = latest_checkpoint_path(ckpt_path)
-        if ck_file is not None:
-            ckpt = load_checkpoint(
-                ck_file, expect_fingerprint=model.checkpoint_fingerprint)
+        # corruption-tolerant resume (ISSUE 12 satellite, shared
+        # contract with cv_train): checksum-verify the newest rotated
+        # checkpoint and fall back to the previous rotation on a
+        # corrupt/truncated file, journaling `checkpoint_fallback`
+        # once the telemetry session exists
+        from commefficient_tpu.utils.checkpoint import load_resilient
+        loaded = load_resilient(
+            ckpt_path,
+            expect_fingerprint=model.checkpoint_fingerprint,
+            on_fallback=lambda p, why: ckpt_fallbacks.append((p, why)))
+        if loaded is not None:
+            ck_file, ckpt = loaded
             lr_scheduler.load_state_dict(
                 {"step_count": model.load_state(ckpt)})
             if coord:
                 print(f"resumed from {ck_file} at round "
                       f"{int(ckpt.server.round_idx)}")
+        if model.plan_transport is not None and cfg.journal_path:
+            # deterministic restart: cross-check replayed rounds
+            # against the pre-crash write-ahead plan stream
+            model.load_plan_stream(cfg.journal_path)
 
     # only the coordinator creates a run dir (its artifacts are the
     # run's outputs; workers would just litter empty dirs)
@@ -567,6 +587,10 @@ def main(argv=None) -> bool:
     tele = attach_run_telemetry(model, cfg, log_dir, coord,
                                 driver="gpt2_train",
                                 materialize=mh.gather_host)
+    if tele is not None:
+        for p, why in ckpt_fallbacks:
+            tele.journal_event("checkpoint_fallback", path=p,
+                               error=why[:200])
     if coord:
         print(f"Finished initializing in {timer():.2f} seconds")
 

@@ -29,13 +29,16 @@ TPU pods):
 """
 from __future__ import annotations
 
+import errno
 import glob as _glob
 import json
 import os
 import queue
 import shutil
 import threading
-from typing import Callable, NamedTuple, Optional, Tuple
+import zipfile
+import zlib
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -112,12 +115,20 @@ class AsyncCheckpointWriter:
 
     _SENTINEL = object()
 
-    def __init__(self, max_pending: int = 2):
+    def __init__(self, max_pending: int = 2,
+                 drain_timeout: float = 0.0,
+                 name: str = "checkpoint"):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(max_pending, 1))
         self._exc: Optional[BaseException] = None
         self._closed = False
+        # writer-thread watchdog (ISSUE 12 satellite): drain()/close()
+        # deadline in seconds (0 = wait forever); `name` labels the
+        # TimeoutError so a hung spill queue reads "state-spill
+        # writer", not "checkpoint writer"
+        self._drain_timeout = float(drain_timeout)
+        self._name = str(name)
         self._thread = threading.Thread(
-            target=self._run, name="ckpt-writer", daemon=True)
+            target=self._run, name=f"{name}-writer", daemon=True)
         self._thread.start()
 
     def _run(self) -> None:
@@ -151,15 +162,21 @@ class AsyncCheckpointWriter:
 
     def drain(self) -> None:
         """Block until every submitted write is durable; re-raise the
-        first writer-side failure."""
-        self._q.join()
+        first writer-side failure (an ENOSPC from a queued save
+        surfaces HERE, on the caller's thread, not silently at
+        shutdown). With a drain_timeout, a hung fsync raises
+        TimeoutError naming this writer (utils/watchdog)."""
+        from commefficient_tpu.utils.watchdog import drain_queue
+        drain_queue(self._q, self._drain_timeout, self._name)
         self._raise_pending()
 
     def close(self) -> None:
-        """Drain, then stop the thread. Idempotent."""
+        """Drain, then stop the thread. Idempotent. Honors the
+        drain_timeout watchdog like drain()."""
         if self._closed:
             return
-        self._q.join()
+        from commefficient_tpu.utils.watchdog import drain_queue
+        drain_queue(self._q, self._drain_timeout, self._name)
         self._closed = True
         self._q.put(self._SENTINEL)
         self._thread.join()
@@ -297,11 +314,27 @@ def save_checkpoint(path: str, server: ServerState,
         # the atomic .tmp + os.replace write — unchanged whether it
         # runs inline or (writer given) on the persistence thread
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                # actionable disk-full error (ISSUE 12 satellite):
+                # names the checkpoint rather than surfacing as a bare
+                # "No space left on device" from deep inside numpy —
+                # under the async writer this re-raises on the
+                # caller's thread at the next submit()/drain()
+                raise OSError(
+                    e.errno,
+                    f"checkpoint write to {path!r} failed: disk full "
+                    "(ENOSPC). Free space on the checkpoint "
+                    "filesystem or point --checkpoint_path at a "
+                    "volume with room; the previous checkpoint is "
+                    "intact (atomic .tmp+replace).") from e
+            raise
 
     if mh.is_coordinator():
         if writer is None:
@@ -426,6 +459,121 @@ def _atomic_write_text(path: str, text: str) -> None:
     os.replace(tmp, path)
 
 
+# ---------------- checkpoint integrity (ISSUE 12 satellite) --------------
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint file failed its integrity check: unreadable npz
+    (truncated/torn bytes) or a per-array checksum mismatch against
+    the manifest recorded at save time. The resilient loader
+    (load_resilient) treats this as 'fall back to the previous
+    rotation', not a crash."""
+
+
+# the errors np.load raises on a truncated/corrupted .npz — the shapes
+# a torn write, a partial copy, or bit rot actually produce
+_NPZ_READ_ERRORS = (OSError, ValueError, KeyError, EOFError,
+                    zipfile.BadZipFile)
+
+
+def file_checksums(path: str) -> Dict[str, int]:
+    """Per-array CRC32s of a checkpoint .npz, from the bytes ON DISK
+    (save_rotating re-reads the file it just wrote, so the manifest
+    checksums vouch for the written artifact, not the in-memory
+    arrays it came from)."""
+    out: Dict[str, int] = {}
+    with np.load(path) as z:
+        for name in z.files:
+            out[name] = zlib.crc32(np.ascontiguousarray(
+                z[name]).tobytes()) & 0xFFFFFFFF
+    return out
+
+
+def verify_checkpoint_file(path: str,
+                           checksums: Optional[Dict[str, int]]
+                           ) -> None:
+    """Integrity-check one checkpoint file: it must be a readable npz
+    and, when the manifest recorded `checksums` for it, every array's
+    CRC32 must match (missing/extra arrays are mismatches too).
+    Raises CorruptCheckpointError; `checksums=None` (a legacy manifest
+    or the glob/fixed-name fallback) checks readability only."""
+    try:
+        found = file_checksums(path)
+    except _NPZ_READ_ERRORS as e:
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} is unreadable "
+            f"({type(e).__name__}: {e}) — truncated or torn write?"
+        ) from e
+    if not checksums:
+        return
+    expect = {k: int(v) for k, v in checksums.items()}
+    if found != expect:
+        bad = sorted(set(expect) ^ set(found)
+                     | {k for k in set(expect) & set(found)
+                        if expect[k] != found[k]})
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} failed its integrity check: "
+            f"array(s) {bad[:5]} disagree with the manifest checksums "
+            "recorded at save time — corrupted on disk?")
+
+
+def load_resilient(prefix: str,
+                   expect_fingerprint: Optional[dict] = None,
+                   on_fallback: Optional[Callable[[str, str], None]]
+                   = None) -> Optional[Tuple[str, Checkpoint]]:
+    """Corruption-tolerant auto-resume (ISSUE 12 satellite): walk the
+    rotation newest-first — manifest history, then stamped files the
+    manifest lost, then the legacy fixed name — integrity-checking
+    each candidate (verify_checkpoint_file, with the manifest's
+    per-array checksums when recorded) and loading the FIRST good one.
+    A corrupt/truncated newest checkpoint therefore falls back to the
+    previous keep-last-k rotation instead of crashing mid-resume;
+    every skipped candidate fires `on_fallback(path, reason)` (the
+    drivers journal a loud `checkpoint_fallback` event) and prints.
+
+    A CheckpointMismatchError (config fingerprint disagreement) is NOT
+    corruption and re-raises immediately: silently falling back past a
+    wrong-config checkpoint would resume from an ancestor of a
+    different run. Returns (path, Checkpoint) or None when nothing
+    loadable exists."""
+    ckpt_dir = os.path.dirname(prefix) or "."
+    candidates: List[str] = []
+    checksums: Dict[str, Dict[str, int]] = {}
+    try:
+        with open(_manifest_path(prefix)) as f:
+            manifest = json.load(f)
+        for base in manifest.get("history", []):
+            candidates.append(os.path.join(ckpt_dir, base))
+        checksums = manifest.get("checksums", {}) or {}
+    except (OSError, ValueError):
+        pass
+    # stamped files the manifest lost track of, newest first; then the
+    # legacy fixed name — the latest_checkpoint_path fallback order
+    seen = set(candidates)
+    for p in sorted(_glob.glob(prefix + "-r*.npz"), reverse=True):
+        if p not in seen:
+            candidates.append(p)
+    fixed = prefix if prefix.endswith(".npz") else prefix + ".npz"
+    if fixed not in seen and os.path.exists(fixed):
+        candidates.append(fixed)
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        try:
+            verify_checkpoint_file(
+                path, checksums.get(os.path.basename(path)))
+            return path, load_checkpoint(
+                path, expect_fingerprint=expect_fingerprint)
+        except CheckpointMismatchError:
+            raise
+        except (CorruptCheckpointError, *_NPZ_READ_ERRORS) as e:
+            reason = f"{type(e).__name__}: {e}"
+            print(f"checkpoint fallback: skipping corrupt {path!r} "
+                  f"({reason}); trying the previous rotation")
+            if on_fallback is not None:
+                on_fallback(path, reason)
+    return None
+
+
 def save_rotating(prefix: str, server: ServerState,
                   clients: Optional[ClientState] = None,
                   keep_last: int = 3, max_age_hours: float = 0.0,
@@ -457,9 +605,12 @@ def save_rotating(prefix: str, server: ServerState,
         base = os.path.basename(path)
         mpath = _manifest_path(prefix)
         history = []
+        old_sums: dict = {}
         try:
             with open(mpath) as f:
-                history = list(json.load(f).get("history", []))
+                m = json.load(f)
+            history = list(m.get("history", []))
+            old_sums = dict(m.get("checksums", {}) or {})
         except (OSError, ValueError):
             pass
         # entries stamped AFTER this round belong to an abandoned
@@ -486,8 +637,25 @@ def save_rotating(prefix: str, server: ServerState,
                 except OSError:
                     return False
             keep = [keep[0]] + [h for h in keep[1:] if fresh(h)]
+        # per-array checksums (ISSUE 12 satellite): computed by
+        # RE-READING the just-written file, so the manifest vouches
+        # for the bytes on disk — load_resilient verifies them at
+        # resume and falls back to the previous rotation on mismatch.
+        # Prior entries' sums carry forward; the dict is trimmed to
+        # the kept history so it cannot grow without bound.
+        try:
+            old_sums[base] = file_checksums(path)
+        except _NPZ_READ_ERRORS as e:
+            # a checkpoint that cannot be re-read right after its
+            # atomic replace is ALREADY corrupt — keep the manifest
+            # entry checksum-less (readability is still checked at
+            # load) but say so loudly
+            print(f"checkpoint warning: cannot checksum just-written "
+                  f"{path!r} ({e})")
+        sums = {b: old_sums[b] for b in keep if b in old_sums}
         _atomic_write_text(mpath, json.dumps(
-            {"latest": base, "history": keep}, indent=2))
+            {"latest": base, "history": keep, "checksums": sums},
+            indent=2))
         # prune every stamped file NOT in the kept history (not just
         # the manifest's own tail): a lost/corrupt manifest must not
         # orphan earlier stamped files forever, and stale

@@ -134,6 +134,28 @@ class FaultSchedule:
                  advance the schedule (set_fault_schedule(None)) once
                  the crash has been exercised, the way the tests
                  resume with a fresh, schedule-free model.
+
+    Control-plane faults (ISSUE 12, parallel/plantransport.py —
+    consumed by the plan TRANSPORT, not by FedModel):
+
+    coordinator_crash_at: the COORDINATOR dies while broadcasting this
+                 round's RoundPlan — before the plan ever reaches the
+                 other controllers or the device (the plan may already
+                 be write-ahead-journaled; the deterministic-restart
+                 path recomputes it and cross-checks the journaled
+                 digest). Raises InjectedFault(round - 1): the last
+                 round that fully completed. Like crash_in_span, it
+                 RE-FIRES if the schedule is still installed on the
+                 resumed transport.
+    broadcast_drop: rounds whose FIRST broadcast send is lost in
+                 flight (TimeoutError; the utils/retry wrapper around
+                 the send recovers on the next attempt).
+    broadcast_dup: rounds delivered TWICE — receivers must install
+                 idempotently (keyed by round index).
+    broadcast_slow: {round_idx: n} — the first n receive attempts for
+                 that round time out before the payload lands (models
+                 a slow coordinator; the receiver's retry loop rides
+                 it out).
     """
     drop: Mapping[int, Sequence[int]] = field(default_factory=dict)
     drop_slots: Mapping[int, Sequence[int]] = field(default_factory=dict)
@@ -141,6 +163,10 @@ class FaultSchedule:
     slow: Mapping[int, Mapping[int, float]] = field(default_factory=dict)
     crash_after: Optional[int] = None
     crash_in_span: Optional[int] = None
+    coordinator_crash_at: Optional[int] = None
+    broadcast_drop: Sequence[int] = ()
+    broadcast_dup: Sequence[int] = ()
+    broadcast_slow: Mapping[int, int] = field(default_factory=dict)
 
     def survival_mask(self, round_idx: int,
                       client_ids: np.ndarray) -> Optional[np.ndarray]:
@@ -201,3 +227,24 @@ class FaultSchedule:
         return (self.crash_in_span is not None
                 and int(first_round) <= int(self.crash_in_span)
                 < int(first_round) + int(n_rounds))
+
+    # ---------------- control-plane fault queries (ISSUE 12) -------------
+    def should_crash_coordinator(self, round_idx: int) -> bool:
+        """True when the coordinator dies broadcasting this round's
+        plan (the transport raises InjectedFault(round_idx - 1))."""
+        return (self.coordinator_crash_at is not None
+                and int(round_idx) == int(self.coordinator_crash_at))
+
+    def broadcast_dropped(self, round_idx: int, attempt: int) -> bool:
+        """True when this round's broadcast SEND attempt is lost (only
+        the first attempt drops; the retry goes through)."""
+        return (attempt == 0 and int(round_idx)
+                in set(int(r) for r in self.broadcast_drop))
+
+    def broadcast_duplicated(self, round_idx: int) -> bool:
+        return int(round_idx) in set(int(r) for r in self.broadcast_dup)
+
+    def broadcast_slow_attempts(self, round_idx: int) -> int:
+        """How many receive attempts for this round time out before
+        the payload is visible (0 = delivered immediately)."""
+        return int(self.broadcast_slow.get(int(round_idx), 0))

@@ -1,0 +1,49 @@
+"""Writer-thread watchdog (ISSUE 12 satellite).
+
+The three bounded-queue persistence writers — the telemetry journal
+(telemetry/journal.RunJournal), the checkpoint serializer
+(utils/checkpoint.AsyncCheckpointWriter), and the tiered-state spill
+queue (federated/statestore, which reuses AsyncCheckpointWriter) —
+all drain with `queue.Queue.join()`, which waits FOREVER. A hung
+fsync (dead NFS mount, a wedged FUSE filesystem) therefore turns the
+crash-time drain — the one code path that runs exactly when the
+operator most needs the process to finish dying — into a silent hang.
+
+`drain_queue` is join-with-deadline: identical semantics to
+`Queue.join()` when every queued write completes, a `TimeoutError`
+NAMING the stuck writer when the deadline passes. The writers take
+the timeout from `Config.writer_drain_timeout_s`
+(`--writer_drain_timeout_s`; 0 keeps the wait-forever default, so
+existing behavior is unchanged unless the knob is set).
+"""
+from __future__ import annotations
+
+import queue
+import time
+
+
+def drain_queue(q: "queue.Queue", timeout: float, name: str) -> None:
+    """`q.join()` bounded by `timeout` seconds.
+
+    timeout <= 0 waits forever (plain join). On expiry raises
+    TimeoutError naming `name` and the number of writes still queued —
+    actionable ("the checkpoint writer is stuck — hung fsync?") where
+    a bare hang is not. Uses the Queue's own all_tasks_done condition
+    (the mechanism join() itself waits on), so completion wake-ups are
+    immediate, not polled."""
+    if timeout is None or timeout <= 0:
+        q.join()
+        return
+    deadline = time.monotonic() + float(timeout)
+    with q.all_tasks_done:
+        while q.unfinished_tasks:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"{name} writer failed to drain within "
+                    f"{float(timeout):.1f}s — {q.unfinished_tasks} "
+                    "queued write(s) still pending (hung fsync / dead "
+                    "filesystem?). The queue is NOT drained; raise "
+                    "--writer_drain_timeout_s or fix the backing "
+                    "store.")
+            q.all_tasks_done.wait(remaining)

@@ -45,8 +45,10 @@ def main(argv=None) -> int:
                         "print to stderr)")
     args = p.parse_args(argv)
 
+    counters: dict = {}
     try:
-        records, problems = validate_journal(args.journal)
+        records, problems = validate_journal(args.journal,
+                                             counters=counters)
     except OSError as e:
         print(f"journal_summary: cannot read {args.journal!r}: {e}",
               file=sys.stderr)
@@ -56,7 +58,13 @@ def main(argv=None) -> int:
         problems = ["journal is empty (no records at all)"]
 
     if not args.quiet:
-        print(json.dumps(summarize(records)))
+        # corrupt interior lines are skipped-and-counted, not
+        # violations (ISSUE 12 satellite) — the count rides in the
+        # summary so a journal that survived a mid-batch writer crash
+        # says so
+        print(json.dumps(summarize(
+            records,
+            corrupt_lines=counters.get("corrupt_interior", 0))))
     if problems:
         for prob in problems:
             print(f"journal_summary: INVALID: {prob}", file=sys.stderr)

@@ -138,6 +138,60 @@ if [ "$rc" -eq 0 ]; then
   python scripts/journal_summary.py "$JR5" \
       || { echo "PIPELINE_JOURNAL_INVALID"; exit 1; }
 
+  # multi-controller control-plane smoke (ISSUE 12): the scheduled
+  # scanned run under the EMULATED N-controller plan transport —
+  # throughput sampling + async admission, every round's plan
+  # broadcast, installed on every controller, digest-cross-checked
+  # and write-ahead journaled — with a scripted coordinator crash
+  # (CCTPU_EMU_COORD_CRASH) mid-run. The first run must FAIL at the
+  # injected crash, the --resume run must complete from the last
+  # persisted boundary, and the combined write-ahead plan journal
+  # must validate.
+  JR7=/tmp/_t1_journal_ctrl.jsonl
+  rm -f "$JR7"
+  rm -rf /tmp/_t1_ctrl_ckpt
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      CCTPU_EMU_COORD_CRASH=1 \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode uncompressed \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --scan_rounds --scan_span 1 \
+      --sampler throughput --async_admit_rounds 1 \
+      --straggler_rate 0.5 --straggler_min_work 0.4 \
+      --plan_transport emulated \
+      --checkpoint --checkpoint_every 1 \
+      --checkpoint_path /tmp/_t1_ctrl_ckpt \
+      --journal_path "$JR7" --dataset_dir /tmp/_t1_ds \
+      >/dev/null 2>&1; then
+    echo "CTRL_SMOKE_CRASH_NOT_INJECTED"; exit 1
+  fi
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode uncompressed \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --scan_rounds --scan_span 1 \
+      --sampler throughput --async_admit_rounds 1 \
+      --straggler_rate 0.5 --straggler_min_work 0.4 \
+      --plan_transport emulated \
+      --checkpoint --checkpoint_every 1 \
+      --checkpoint_path /tmp/_t1_ctrl_ckpt \
+      --journal_path "$JR7" --dataset_dir /tmp/_t1_ds --resume \
+      >/dev/null 2>&1 \
+      || { echo "CTRL_SMOKE_RESUME_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR7" \
+      || { echo "CTRL_JOURNAL_INVALID"; exit 1; }
+  python - "$JR7" <<'PYEOF' || { echo "CTRL_NO_DIGESTS"; exit 1; }
+import json, sys
+digs = [json.loads(l).get("digest") for l in open(sys.argv[1])
+        if '"schedule"' in l]
+assert digs and all(isinstance(d, str) and len(d) == 64 for d in digs), \
+    "control-plane smoke journaled no write-ahead plan digests"
+PYEOF
+
   # large-population smoke (ISSUE 9 satellite): the O(active) refactor
   # driven end-to-end at a 100k-client population with the --test tiny
   # model (D=100) and local_topk + local error + momentum + topk_down,

@@ -251,15 +251,26 @@ def test_append_after_torn_tail_seals_fragment(tmp_path):
     """A resume appending to a journal whose last append was torn
     mid-write must not concatenate onto the fragment: the torn line is
     sealed with a newline, stays its own (detectably invalid) line,
-    and every committed record before AND after it survives."""
+    and every committed record before AND after it survives. Once
+    sealed it is an INTERIOR corrupt line — skipped-and-counted
+    (ISSUE 12), not a validation failure: the resumed journal still
+    validates, and the count surfaces through `counters` into
+    summarize()."""
+    from commefficient_tpu.telemetry.journal import summarize
     jpath = str(tmp_path / "resumed.jsonl")
     append_event(jpath, "round", round=0)
     with open(jpath, "ab") as f:  # simulate a mid-append preemption
         f.write(b'{"v": 1, "event": "round", "ts": 2.0, "ro')
     append_event(jpath, "round", round=1)  # the "resumed" process
-    records, problems = validate_journal(jpath)
+    counters = {}
+    records, problems = validate_journal(jpath, counters=counters)
     assert [r.get("round") for r in records] == [0, 1]
-    assert len(problems) == 1 and "not valid JSON" in problems[0]
+    assert problems == []  # the sealed fragment is tolerated...
+    assert counters["corrupt_interior"] == 1  # ...but counted
+    assert counters["corrupt_lines"] == [2]
+    summary = summarize(records, corrupt_lines=counters[
+        "corrupt_interior"])
+    assert summary["corrupt_lines"] == 1
 
 
 def test_journal_nonfinite_metrics_stay_strict_json(tmp_path):
