@@ -314,10 +314,10 @@ def main() -> int:
     # see PERF.md).
     run_digest = build_digest(cfg)
 
-    t0 = time.time()
+    t0 = time.monotonic()
     with alarm_guard(STAGE_TIMEOUT, "compile+first run"):
         float(np.asarray(run_digest(server, clients, batches, lrs, key)))
-    log(f"compile+first run: {time.time() - t0:.1f}s")
+    log(f"compile+first run: {time.monotonic() - t0:.1f}s")
 
     flops_per_round = cost_flops(
         run_digest, (server, clients, batches, lrs, key), ROUNDS)
@@ -791,7 +791,12 @@ def pipeline_main() -> int:
         tele.close(ok=True)
         recs, problems = validate_journal(jpath)
         assert not problems, problems
-        ts = [r["ts"] for r in recs if r.get("event") == "round"]
+        # inter-round gaps on the MONOTONIC stamp (ISSUE 13): a wall-
+        # clock `ts` diff is not a duration — an NTP step mid-sweep
+        # would corrupt the cadence histogram (graftlint GL011's
+        # hazard class, held out of the journal-reading path too)
+        ts = [r.get("mono", r["ts"]) for r in recs
+              if r.get("event") == "round"]
         gaps = np.diff(np.asarray(ts, np.float64))[WARMUP:]
         weights = np.asarray(model.server.ps_weights)
         assert np.all(np.isfinite(weights)), \
@@ -830,6 +835,124 @@ def pipeline_main() -> int:
         "sync": sync,
         "pipelined": pipe,
         "bit_identical": bit_identical,
+    }
+    journal_digest(out, "bench_digest")
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def trace_main() -> int:
+    """ISSUE 13 graftscope arm: the pipelined cadence workload of
+    pipeline_main rerun with --trace armed, so the bench digest gains
+    the STAGE-RESOLVED view — per-stage p50 seconds, writer queue
+    gauges, and the pipeline overlap-efficiency metric (device-busy /
+    wall over the device_execute spans) — turning BENCH_r10's one-off
+    0.79x cadence claim into a continuously-measured number. Every
+    duration comes from monotonic span records, never wall-clock
+    diffs. In-process and CPU-friendly; invoked via BENCH_TRACE=1 or
+    `python bench.py --trace`. Lands in BENCH_r13.json."""
+    import tempfile
+
+    import numpy as np
+
+    with alarm_guard(INIT_TIMEOUT, "backend init"):
+        import jax
+        import jax.numpy as jnp
+        platform = jax.devices()[0].platform
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.federated.api import FedModel, FedOptimizer
+    from commefficient_tpu.telemetry import TelemetrySession
+    from commefficient_tpu.telemetry.journal import (
+        RunJournal, summarize, validate_journal,
+    )
+    from commefficient_tpu.training.scanloop import (
+        make_span_checkpoint, run_scanned_rounds,
+    )
+    from commefficient_tpu.utils.schedules import LambdaLR
+
+    Dp = int(os.environ.get("BENCH_TRACE_D", "65536"))
+    Wp, Bp = 8, 32
+    ROUNDS_T = int(os.environ.get("BENCH_TRACE_ROUNDS", "40"))
+    WARMUP = 8
+    log(f"graftscope stage sweep on {platform} "
+        f"(D={Dp}, {ROUNDS_T} rounds, span=1, trace on)")
+
+    def loss_fn(params, batch, mask):
+        x, y = batch
+        pred = x @ params["w"]
+        per_ex = 0.5 * (pred - y) ** 2
+        loss = (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, (loss,)
+
+    LR = 1e-4
+    rng = np.random.RandomState(0)
+    x = rng.randn(Wp, Bp, Dp).astype(np.float32)
+    y = rng.randn(Wp, Bp).astype(np.float32)
+    ids = np.arange(Wp, dtype=np.int32)
+    mask = np.ones((Wp, Bp), np.float32)
+    stream = [(r, ids, (x, y), mask, LR) for r in range(ROUNDS_T)]
+
+    with tempfile.TemporaryDirectory() as td:
+        cfg = Config(
+            mode="uncompressed", error_type="none", local_momentum=0.0,
+            virtual_momentum=0.9, grad_size=Dp, weight_decay=0.0,
+            num_workers=Wp, microbatch_size=-1, num_clients=Wp,
+            checkpoint_every=1, ckpt_every_spans=1, keep_checkpoints=2,
+            pipeline=True, trace=True, seed=0).validate()
+        model = FedModel(None, loss_fn, cfg,
+                         params={"w": jnp.zeros(Dp, jnp.float32)})
+        opt = FedOptimizer(model)
+        opt.param_groups[0]["lr"] = LR
+        sch = LambdaLR(opt, lr_lambda=lambda s: 1.0)
+        jpath = os.path.join(td, "journal.jsonl")
+        tele = TelemetrySession(
+            journal=RunJournal(jpath, run_id="bench",
+                               async_writer=True),
+            trace=True)
+        model.attach_telemetry(tele)
+        hook = make_span_checkpoint(os.path.join(td, "ck"), model,
+                                    cfg, sch)
+        with alarm_guard(STAGE_TIMEOUT, "traced pipelined rounds"):
+            t0 = time.perf_counter()
+            ok = run_scanned_rounds(model, iter(stream), 1,
+                                    lambda *a: True, checkpoint=hook,
+                                    pipeline=True)
+            assert ok
+            wall = time.perf_counter() - t0
+        model.close_persistence()
+        tele.close(ok=True)
+        recs, problems = validate_journal(jpath)
+        assert not problems, problems
+        weights = np.asarray(model.server.ps_weights)
+        assert np.all(np.isfinite(weights)), \
+            "bench workload diverged — lower LR"
+        summary = summarize(recs)
+        mono = [r["mono"] for r in recs if r.get("event") == "round"]
+        gaps = np.diff(np.asarray(mono, np.float64))[WARMUP:]
+
+    stages = summary.get("trace_stages", {})
+    out = {
+        "metric": "stage_resolved_round_cadence",
+        "value": round(float(np.percentile(gaps, 50)), 6),
+        "unit": "s/round (p50 inter-round, monotonic journal stamps)",
+        "vs_baseline": None,
+        "platform": platform,
+        "geometry": {"D": Dp, "num_workers": Wp, "local_batch": Bp,
+                     "rounds": ROUNDS_T, "scan_span": 1,
+                     "ckpt_every_spans": 1, "mode": "uncompressed",
+                     "pipeline": True, "trace": True},
+        "p95_inter_round_s": round(float(np.percentile(gaps, 95)), 6),
+        "wall_s": round(wall, 3),
+        # the stage-resolved cadence baseline: per-stage p50 seconds
+        # over the whole sweep (ISSUE 13 acceptance)
+        "stage_p50_s": {name: st["p50_s"]
+                        for name, st in sorted(stages.items())},
+        "stage_p95_s": {name: st["p95_s"]
+                        for name, st in sorted(stages.items())},
+        "overlap_efficiency": summary.get("overlap_efficiency"),
+        "writer_queue_max": summary.get("writer_queue_max", {}),
+        "trace_spans": summary.get("trace_spans", 0),
     }
     journal_digest(out, "bench_digest")
     print(json.dumps(out), flush=True)
@@ -1059,6 +1182,11 @@ if __name__ == "__main__":
         # ISSUE 10 pipeline cadence sweep: in-process (CPU-friendly);
         # sync vs pipelined round cadence from journal round events
         raise SystemExit(worker_entry(pipeline_main))
+    if (os.environ.get("BENCH_TRACE") == "1"
+            or "--trace" in sys.argv):
+        # ISSUE 13 graftscope arm: stage-resolved cadence (per-stage
+        # p50s + overlap efficiency) on the traced pipelined workload
+        raise SystemExit(worker_entry(trace_main))
     if (os.environ.get("BENCH_POPULATION") == "1"
             or "--population" in sys.argv):
         # ISSUE 9 population sweep: in-process (tiny D, CPU-friendly);

@@ -763,6 +763,99 @@ def check_gl010(module: ModuleInfo) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GL011 — wall-clock deltas used as durations
+
+# A difference of two time.time() readings is NOT a duration: the wall
+# clock steps under NTP correction (and jumps at DST/admin changes),
+# so a duration derived from it can come out negative or wildly wrong
+# exactly when a long production run crosses a correction — the hazard
+# class graftscope (ISSUE 13) exists to measure AROUND. Durations must
+# come from time.monotonic()/time.perf_counter(); wall time is for
+# timestamps and cross-machine correlation only (the journal records
+# both: `ts` wall, `mono` monotonic). The rule is syntactic + local:
+# it flags a subtraction where BOTH operands are wall-clock-derived —
+# a direct time.time()/time.time_ns() call, or a local name assigned
+# from one in the same function scope. Comparing time.time() against
+# an offset or a file mtime (checkpoint age GC) subtracts a
+# NON-clock operand and is legitimately wall-clock — not flagged.
+
+_GL011_WALL_CALLS = frozenset({"time.time", "time.time_ns"})
+
+
+def _is_wall_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and (_dotted(node.func) or "") in _GL011_WALL_CALLS)
+
+
+def _gl011_scopes(tree: ast.Module) -> Iterator[ast.AST]:
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _gl011_scope_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Nodes lexically in `scope` ITSELF — nested function bodies are
+    pruned (each is its own GL011 scope: a name bound from
+    time.time() in one function must not taint the same name used as
+    an ordinary parameter in another)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_gl011(module: ModuleInfo) -> Iterator[Violation]:
+    seen: Set[Tuple[int, int]] = set()
+    for scope in _gl011_scopes(module.tree):
+        # names bound DIRECTLY from a wall-clock call in this scope
+        wall_names: Set[str] = set()
+        for node in _gl011_scope_nodes(scope):
+            if (isinstance(node, ast.Assign)
+                    and _is_wall_call(node.value)):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        wall_names.add(tgt.id)
+            elif (isinstance(node, ast.AnnAssign)
+                    and node.value is not None
+                    and _is_wall_call(node.value)
+                    and isinstance(node.target, ast.Name)):
+                wall_names.add(node.target.id)
+
+        def _wall_derived(expr: ast.AST) -> Optional[str]:
+            if _is_wall_call(expr):
+                return f"{_dotted(expr.func)}()"
+            if isinstance(expr, ast.Name) and expr.id in wall_names:
+                return f"`{expr.id}` (assigned from time.time())"
+            return None
+
+        for node in _gl011_scope_nodes(scope):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            left = _wall_derived(node.left)
+            right = _wall_derived(node.right)
+            if left is None or right is None:
+                continue
+            seen.add(key)
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL011",
+                f"wall-clock delta used as a duration: {left} - "
+                f"{right}. time.time() steps under NTP correction, "
+                "so its differences are not durations — use "
+                "time.monotonic()/time.perf_counter() for intervals "
+                "(keep time.time() for timestamps and comparisons "
+                "against external wall-clock values like file "
+                "mtimes)")
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "GL001": check_gl001,
@@ -775,6 +868,7 @@ ALL_RULES = {
     "GL008": check_gl008,
     "GL009": check_gl009,
     "GL010": check_gl010,
+    "GL011": check_gl011,
 }
 
 RULE_DOCS = {
@@ -800,4 +894,7 @@ RULE_DOCS = {
     "GL010": "mesh-axis name in a sharding construction (parallel/, "
              "federated/) outside the analysis/domains MESH_AXES "
              "registry",
+    "GL011": "wall-clock delta (time.time() difference) used as a "
+             "duration — NTP steps corrupt it; use "
+             "time.monotonic()/perf_counter for intervals",
 }

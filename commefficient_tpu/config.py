@@ -94,6 +94,24 @@ class Config:
     # silent TPU performance cliff — raises instead of slowly burning
     # the tunnel (ROADMAP PR-3 opening)
     debug_transfer_guard: bool = False
+    # graftscope round-lifecycle tracing (ISSUE 13,
+    # telemetry/trace.py). OFF by default: the tracer exists but
+    # records nothing and adds zero journal writes (the only schema
+    # change that lands regardless of this flag is the `mono`
+    # timestamp every journal record carries). ON: monotonic-clock
+    # spans bracket every HOST stage of the round lifecycle — plan
+    # composition/broadcast, operand staging, dispatch, the
+    # device-execute window at the dispatch/collect seam, tiered-state
+    # restore/spill, collection/accounting, checkpoint saves, and each
+    # writer thread's queue-wait + fsync — tagged with (round, span,
+    # controller, thread) correlation keys, buffered in per-thread
+    # rings, and flushed as batched `trace` journal events at span
+    # boundaries. Zero traced-program changes either way (spans wrap
+    # dispatch calls, never jitted code); scripts/trace_export.py
+    # converts the journal to Perfetto-loadable Chrome trace JSON and
+    # journal_summary.py reports per-stage p50/p95 + overlap
+    # efficiency.
+    trace: bool = False
 
     # compression (utils.py:142-147)
     k: int = 50000
@@ -590,6 +608,13 @@ class Config:
                 raise ValueError(
                     "--profile_spans requires telemetry (drop "
                     "--no_telemetry: the session drives the capture)")
+        if self.trace and not self.telemetry:
+            # the tracer flushes through the telemetry session's
+            # journal; without the session nothing would ever drain
+            # the rings — fail loud like --profile_spans
+            raise ValueError(
+                "--trace requires telemetry (drop --no_telemetry: "
+                "the session drains the trace rings into the journal)")
         if self.sampler not in ("uniform", "throughput"):
             raise ValueError(
                 f"unknown sampler {self.sampler!r} (choices: uniform, "
@@ -802,6 +827,17 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                         "scanned span indices [A, B), e.g. '2:4' "
                         "(trace lands in <run dir>/profile_spans and "
                         "the capture is journaled)")
+    p.add_argument("--trace", action="store_true",
+                   help="graftscope round-lifecycle tracing: "
+                        "monotonic stage spans (plan/stage/dispatch/"
+                        "device_execute/collect/tier motion/writer "
+                        "queue-wait+fsync) buffered per thread and "
+                        "flushed as batched `trace` journal events; "
+                        "export with scripts/trace_export.py "
+                        "(Perfetto), analyze with journal_summary.py "
+                        "(per-stage p50/p95, overlap efficiency). "
+                        "OFF by default — zero overhead, journal "
+                        "unchanged (telemetry/trace.py)")
     p.add_argument("--debug_transfer_guard", action="store_true",
                    help="arm jax.transfer_guard('disallow') around "
                         "the steady-state training loop: any implicit "

@@ -52,6 +52,7 @@ from commefficient_tpu.parallel.plantransport import (
     PlanDigestError, install_digest,
 )
 from commefficient_tpu.telemetry.clients import ClientThroughputTracker
+from commefficient_tpu.telemetry.trace import TRACE
 from commefficient_tpu.utils.faults import (
     FaultSchedule, InjectedFault, bernoulli_survivors,
     straggler_work_fractions,
@@ -96,6 +97,12 @@ class _SpanHandle(NamedTuple):
     bits: jax.Array                   # [N, D/32] change bitsets
     t_dispatch0: float
     t_dispatched: float
+    # graftscope correlation (ISSUE 13): the scanned-span index at
+    # dispatch — the same counter --profile_spans selects on, so the
+    # device_execute trace span recorded at collect correlates with a
+    # jax.profiler capture of the same span. -1 = unknown (callers
+    # outside the scanloop).
+    span_idx: int = -1
 
 
 class FedModel:
@@ -967,25 +974,33 @@ class FedModel:
                     this_round, 1)):
             self._journal_fault("crash_in_span", this_round - 1)
             raise InjectedFault(this_round - 1)
-        survivors, work = self._faults_for_round(this_round, client_ids)
-        admits = ()
-        if self.async_admit is not None:
-            # buffered async aggregation (federated/async_agg): defer
-            # this round's stragglers onto the dropped-client path and
-            # merge admissions due this round into the cohort operands
-            (client_ids, data, mask, survivors,
-             work) = self.async_admit.compose(
-                this_round, client_ids, data, mask, survivors, work)
-            admits = self.async_admit.last_admits
-        # write-ahead plan seal (ISSUE 12): digest + journal the
-        # composed control decision, flush it durable before this
-        # round's dispatch, and cross-check against the other
-        # controllers / the replayed journal. No-op without a
-        # transport or replay stream (beyond the journaling the
-        # scheduler always got).
-        self._seal_plan(this_round, client_ids, survivors, work,
-                        admits)
-        self._flush_write_ahead()
+        # graftscope (ISSUE 13): the `plan` stage — fault/schedule
+        # composition, async admission, and the write-ahead seal; the
+        # scheduler's broadcast/install work nests inside as
+        # `plan_install` spans
+        with TRACE.span("plan", round=this_round):
+            survivors, work = self._faults_for_round(this_round,
+                                                     client_ids)
+            admits = ()
+            if self.async_admit is not None:
+                # buffered async aggregation (federated/async_agg):
+                # defer this round's stragglers onto the
+                # dropped-client path and merge admissions due this
+                # round into the cohort operands
+                (client_ids, data, mask, survivors,
+                 work) = self.async_admit.compose(
+                    this_round, client_ids, data, mask, survivors,
+                    work)
+                admits = self.async_admit.last_admits
+            # write-ahead plan seal (ISSUE 12): digest + journal the
+            # composed control decision, flush it durable before this
+            # round's dispatch, and cross-check against the other
+            # controllers / the replayed journal. No-op without a
+            # transport or replay stream (beyond the journaling the
+            # scheduler always got).
+            self._seal_plan(this_round, client_ids, survivors, work,
+                            admits)
+            self._flush_write_ahead()
 
         # tiered client state (ISSUE 11): assign device slots AFTER
         # admission composition (an admitted client needs a slot too).
@@ -998,24 +1013,26 @@ class FedModel:
             tier_plan = self.state_store.plan_round(client_ids)
             ids_for_device = tier_plan.slots
 
-        P = self._P
-        lr = self._lr()
-        # explicit placement for BOTH lr shapes: a raw python float
-        # operand is an IMPLICIT host->device transfer at every
-        # dispatch — the first thing --debug_transfer_guard caught.
-        # np.float32(lr) is the identical f32 value the weak-typed
-        # scalar would have become, so results are bit-unchanged.
-        lr = mh.globalize(self.mesh, P(),
-                          lr if isinstance(lr, np.ndarray)
-                          else np.float32(lr))
-        placed = fround.RoundBatch(
-            mh.globalize(self.mesh, P(), ids_for_device),
-            tuple(self._feed(d) for d in data),
-            self._feed(mask),
-            None if survivors is None
-            else mh.globalize(self.mesh, P(), survivors),
-            None if work is None
-            else mh.globalize(self.mesh, P(), work))
+        with TRACE.span("stage", round=this_round):
+            P = self._P
+            lr = self._lr()
+            # explicit placement for BOTH lr shapes: a raw python
+            # float operand is an IMPLICIT host->device transfer at
+            # every dispatch — the first thing --debug_transfer_guard
+            # caught. np.float32(lr) is the identical f32 value the
+            # weak-typed scalar would have become, so results are
+            # bit-unchanged.
+            lr = mh.globalize(self.mesh, P(),
+                              lr if isinstance(lr, np.ndarray)
+                              else np.float32(lr))
+            placed = fround.RoundBatch(
+                mh.globalize(self.mesh, P(), ids_for_device),
+                tuple(self._feed(d) for d in data),
+                self._feed(mask),
+                None if survivors is None
+                else mh.globalize(self.mesh, P(), survivors),
+                None if work is None
+                else mh.globalize(self.mesh, P(), work))
         self._rounds_staged = this_round + 1
         return _StagedRound(this_round, placed, lr,
                             np.asarray(client_ids), survivors,
@@ -1042,12 +1059,15 @@ class FedModel:
             # restore-scatter the misses' host rows into their slots —
             # both through the round handle's existing state-motion
             # programs, so the gather below reads a fully-resident
-            # working set
-            self.clients = self.state_store.execute(
-                self.clients, staged.tier_plan)
-        self.server, self.clients, metrics = self._train_round(
-            self.server, self.clients, staged.batch, staged.lr,
-            self._key)
+            # working set. The graftscope bracket carries the round
+            # tag the nested tier_spill/tier_restore spans inherit.
+            with TRACE.span("tier_motion", round=this_round):
+                self.clients = self.state_store.execute(
+                    self.clients, staged.tier_plan)
+        with TRACE.span("dispatch", round=this_round):
+            self.server, self.clients, metrics = self._train_round(
+                self.server, self.clients, staged.batch, staged.lr,
+                self._key)
         self._rounds_done = this_round + 1
         # O(cohort) checkpoint support: these rows may now differ from
         # their init values (dropped clients' rows were written back
@@ -1066,13 +1086,15 @@ class FedModel:
         # bits here instead would block on the round that was just
         # dispatched — a full round-trip of sync per round on the
         # tunnel (PERF.md measurement rules).
-        bits = self._pack_bits(self.server.ps_weights - prev_weights)
-        bits.copy_to_host_async()
-        download, upload = self.accountant.record_round(
-            staged.client_ids,
-            None if self._prev_change_words is None
-            else np.asarray(self._prev_change_words),
-            survivors=staged.survivors)
+        with TRACE.span("collect", round=this_round):
+            bits = self._pack_bits(self.server.ps_weights
+                                   - prev_weights)
+            bits.copy_to_host_async()
+            download, upload = self.accountant.record_round(
+                staged.client_ids,
+                None if self._prev_change_words is None
+                else np.asarray(self._prev_change_words),
+                survivors=staged.survivors)
         self._prev_change_words = bits
 
         # telemetry, one-round lag (same discipline as the metric
@@ -1202,57 +1224,69 @@ class FedModel:
         # here) and the composed ids/data/mask rows replace the staged
         # ones — still a pure host-side merge on the cohort operands.
         surv_all = work_all = None
+        span_idx = int(getattr(self, "_spans_dispatched", 0))
         if (self.cfg.client_dropout > 0 or self.cfg.straggler_rate > 0
                 or self.fault_schedule is not None
                 or self._scheduler_active()
                 or self.async_admit is not None
                 or self.plan_transport is not None
                 or self._replay_digests):
-            copied = False
-            rows = []
-            for n in range(n_rounds):
-                s, w = self._faults_for_round(first + n, ids_host[n])
-                admits = ()
-                if self.async_admit is not None:
-                    row_ids = ids_host[n]
-                    row_data = tuple(np.asarray(d)[n] for d in data)
-                    row_mask = np.asarray(mask)[n]
-                    ids_n, data_n, mask_n, s, w = \
-                        self.async_admit.compose(
-                            first + n, row_ids, row_data, row_mask,
-                            s, w)
-                    admits = self.async_admit.last_admits
-                    if ids_n is not row_ids:
-                        # an admission rewrote this round's cohort
-                        # rows — copy the span containers LAZILY (the
-                        # caller's staged arrays stay untouched; the
-                        # common nothing-due case pays no memcpy)
-                        if not copied:
-                            ids_host = np.array(ids_host, copy=True)
-                            data = tuple(
-                                np.array(np.asarray(d), copy=True)
-                                for d in data)
-                            mask = np.array(np.asarray(mask),
-                                            copy=True)
-                            copied = True
-                        ids_host[n] = ids_n
-                        for d, d_n in zip(data, data_n):
-                            d[n] = d_n
-                        mask[n] = mask_n
-                # write-ahead seal per round (ISSUE 12): the whole
-                # span's sealed records flush as one barrier below,
-                # still BEFORE the span's dispatch
-                self._seal_plan(first + n, ids_host[n], s, w, admits)
-                rows.append((s, w))
-            ones = np.ones(ids_host.shape[1], np.float32)
-            if any(w is not None for _, w in rows):
-                work_all = np.stack(
-                    [w if w is not None else ones for _, w in rows])
-                surv_all = np.stack(
-                    [s if s is not None else ones for s, _ in rows])
-            elif any(s is not None for s, _ in rows):
-                surv_all = np.stack(
-                    [s if s is not None else ones for s, _ in rows])
+            # graftscope: the whole span's per-round composition is
+            # ONE `plan` stage span (tagged with the first round)
+            with TRACE.span("plan", round=first, span=span_idx):
+                copied = False
+                rows = []
+                for n in range(n_rounds):
+                    s, w = self._faults_for_round(first + n,
+                                                  ids_host[n])
+                    admits = ()
+                    if self.async_admit is not None:
+                        row_ids = ids_host[n]
+                        row_data = tuple(np.asarray(d)[n]
+                                         for d in data)
+                        row_mask = np.asarray(mask)[n]
+                        ids_n, data_n, mask_n, s, w = \
+                            self.async_admit.compose(
+                                first + n, row_ids, row_data,
+                                row_mask, s, w)
+                        admits = self.async_admit.last_admits
+                        if ids_n is not row_ids:
+                            # an admission rewrote this round's cohort
+                            # rows — copy the span containers LAZILY
+                            # (the caller's staged arrays stay
+                            # untouched; the common nothing-due case
+                            # pays no memcpy)
+                            if not copied:
+                                ids_host = np.array(ids_host,
+                                                    copy=True)
+                                data = tuple(
+                                    np.array(np.asarray(d), copy=True)
+                                    for d in data)
+                                mask = np.array(np.asarray(mask),
+                                                copy=True)
+                                copied = True
+                            ids_host[n] = ids_n
+                            for d, d_n in zip(data, data_n):
+                                d[n] = d_n
+                            mask[n] = mask_n
+                    # write-ahead seal per round (ISSUE 12): the whole
+                    # span's sealed records flush as one barrier
+                    # below, still BEFORE the span's dispatch
+                    self._seal_plan(first + n, ids_host[n], s, w,
+                                    admits)
+                    rows.append((s, w))
+                ones = np.ones(ids_host.shape[1], np.float32)
+                if any(w is not None for _, w in rows):
+                    work_all = np.stack(
+                        [w if w is not None else ones
+                         for _, w in rows])
+                    surv_all = np.stack(
+                        [s if s is not None else ones
+                         for s, _ in rows])
+                elif any(s is not None for s, _ in rows):
+                    surv_all = np.stack(
+                        [s if s is not None else ones
+                         for s, _ in rows])
 
         # tiered client state (ISSUE 11): the span executes as ONE
         # device program with the working-set block on the scan carry,
@@ -1267,11 +1301,13 @@ class FedModel:
         # accounting/telemetry.
         ids_device = ids_host
         if self.state_store is not None:
-            plans = self.state_store.plan_span(ids_host)
-            for plan in plans:
-                self.clients = self.state_store.execute(
-                    self.clients, plan)
-            ids_device = np.stack([p.slots for p in plans])
+            with TRACE.span("tier_motion", round=first,
+                            span=span_idx):
+                plans = self.state_store.plan_span(ids_host)
+                for plan in plans:
+                    self.clients = self.state_store.execute(
+                        self.clients, plan)
+                ids_device = np.stack([p.slots for p in plans])
 
         if self.lr_scale_vec is not None:
             # per-parameter LR scaling — same routing _lr() applies on
@@ -1341,9 +1377,14 @@ class FedModel:
         # span must be durable before the span executes
         self._flush_write_ahead()
         t_dispatch0 = time.monotonic()
-        self.server, self.clients, metrics, bits = with_retries(
-            dispatch, describe="scanned round span",
-            classify=_span_classify, on_retry=_journal_retry)
+        # graftscope: the `dispatch` span is the HOST cost of staging
+        # + dispatching the scanned program (operand placement and
+        # the async dispatch call) — the device-side window is the
+        # `device_execute` span collect_rounds records at the seam
+        with TRACE.span("dispatch", round=first, span=span_idx):
+            self.server, self.clients, metrics, bits = with_retries(
+                dispatch, describe="scanned round span",
+                classify=_span_classify, on_retry=_journal_retry)
         t_dispatched = time.monotonic()
         self._rounds_done = first + n_rounds
         self._rounds_staged = max(self._rounds_staged,
@@ -1358,7 +1399,8 @@ class FedModel:
                            crash_at=crash_at, account=account,
                            metrics=metrics, bits=bits,
                            t_dispatch0=t_dispatch0,
-                           t_dispatched=t_dispatched)
+                           t_dispatched=t_dispatched,
+                           span_idx=span_idx)
 
     def collect_rounds(self, handle: "_SpanHandle"):
         """Block on a dispatched span's results and COMMIT it: the
@@ -1386,31 +1428,47 @@ class FedModel:
         # analysis/runtime.forbid_transfers around the whole call
         bits_host = jax.device_get(handle.bits)
         t_blocked = time.monotonic()
-
-        if self._prev_change_words is not None:
-            # may still be a device array from a preceding single-round
-            # call (the lazy-sync path in _call_train)
-            self._prev_change_words = jax.device_get(
-                self._prev_change_words)
-        comm_rows = []
-        for n in range(ids_host.shape[0]):
-            surv_n = None if surv_all is None else surv_all[n]
-            if account:
-                d, u = self.accountant.record_round(
-                    ids_host[n], self._prev_change_words,
-                    survivors=surv_n)
-                download += d.sum()
-                upload += u.sum()
-                comm_rows.append((float(d.sum()), float(u.sum())))
-            else:
-                # keep the change deque and staleness counters in sync
-                # (skipping only the popcount work) so a later accounted
-                # round doesn't misattribute downloads across the gap
-                self.accountant.advance_round(
-                    ids_host[n], self._prev_change_words,
-                    survivors=surv_n)
-                comm_rows.append(None)
-            self._prev_change_words = bits_host[n]
+        # graftscope: the device-execute window, bracketed at the
+        # dispatch/collect seam — dispatch-returned to span-results-
+        # forced. Under --pipeline consecutive spans' windows overlap
+        # (the double buffer working); the overlap-efficiency metric
+        # in summarize() takes the interval UNION. The span tag is
+        # the scanned-span index --profile_spans selects on, so a
+        # jax.profiler capture correlates with exactly these spans.
+        TRACE.record("device_execute", handle.t_dispatched, t_blocked,
+                     round=handle.first,
+                     span=(handle.span_idx
+                           if handle.span_idx >= 0 else None))
+        with TRACE.span("collect", round=handle.first,
+                        span=(handle.span_idx
+                              if handle.span_idx >= 0 else None)):
+            if self._prev_change_words is not None:
+                # may still be a device array from a preceding
+                # single-round call (the lazy-sync path in
+                # _call_train)
+                self._prev_change_words = jax.device_get(
+                    self._prev_change_words)
+            comm_rows = []
+            for n in range(ids_host.shape[0]):
+                surv_n = None if surv_all is None else surv_all[n]
+                if account:
+                    d, u = self.accountant.record_round(
+                        ids_host[n], self._prev_change_words,
+                        survivors=surv_n)
+                    download += d.sum()
+                    upload += u.sum()
+                    comm_rows.append((float(d.sum()),
+                                      float(u.sum())))
+                else:
+                    # keep the change deque and staleness counters in
+                    # sync (skipping only the popcount work) so a
+                    # later accounted round doesn't misattribute
+                    # downloads across the gap
+                    self.accountant.advance_round(
+                        ids_host[n], self._prev_change_words,
+                        survivors=surv_n)
+                    comm_rows.append(None)
+                self._prev_change_words = bits_host[n]
 
         # span-boundary telemetry export: ONE explicit device_get of
         # the [N, M] metric rows + [N, W] example counts, after the

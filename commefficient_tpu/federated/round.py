@@ -47,6 +47,7 @@ from commefficient_tpu.federated import client as fclient
 from commefficient_tpu.federated import server as fserver
 from commefficient_tpu.ops.flat import masked_topk
 from commefficient_tpu.telemetry import metrics as tmetrics
+from commefficient_tpu.telemetry.trace import TRACE
 
 
 class ServerState(NamedTuple):
@@ -863,11 +864,20 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         ROUND_DEAD_ARGNUMS / SCATTER_DEAD_ARGNUMS / SPAN_DEAD_ARGNUMS."""
 
         def __call__(self, server, clients, batch, lr, key):
-            cohort = _gather_jit(clients, batch.client_ids)
-            server, new_cohort, metrics = _train_round_jit(
-                server, cohort, batch, lr, key)
-            clients = _scatter_jit(clients, batch.client_ids,
-                                   new_cohort)
+            # graftscope (ISSUE 13): HOST-side spans around the three
+            # dispatches — asynchronous dispatch cost, not device
+            # time (that's the device_execute bracket at the
+            # dispatch/collect seam). The round/span tags inherit
+            # from the caller's enclosing `dispatch` span; nothing
+            # here touches the traced programs.
+            with TRACE.span("gather"):
+                cohort = _gather_jit(clients, batch.client_ids)
+            with TRACE.span("round_dispatch"):
+                server, new_cohort, metrics = _train_round_jit(
+                    server, cohort, batch, lr, key)
+            with TRACE.span("scatter"):
+                clients = _scatter_jit(clients, batch.client_ids,
+                                       new_cohort)
             return server, clients, metrics
 
     handle = TrainRound()

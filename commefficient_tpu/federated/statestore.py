@@ -67,6 +67,7 @@ from jax.sharding import PartitionSpec as P
 
 from commefficient_tpu.federated import round as fround
 from commefficient_tpu.parallel import multihost as mh
+from commefficient_tpu.telemetry.trace import TRACE
 
 # the tracked client-state blocks, in ClientState field order — the
 # serialization contract shared with utils/checkpoint's crows_* keys
@@ -399,11 +400,16 @@ class TieredStateStore:
         (scatter donates the old one under Config.donate_round_state,
         exactly like the post-round scatter-back)."""
         W = int(self.cfg.num_workers)
+        # graftscope (ISSUE 13): tier motion as distinct stage spans
+        # (one per chunk dispatch) — round/span tags inherit from the
+        # caller's tier_motion bracket (federated/api)
         for lo in range(0, len(plan.spills), W):
-            self._spill_chunk(clients, plan.spills[lo:lo + W], W)
+            with TRACE.span("tier_spill"):
+                self._spill_chunk(clients, plan.spills[lo:lo + W], W)
         for lo in range(0, len(plan.restores), W):
-            clients = self._restore_chunk(
-                clients, plan.restores[lo:lo + W], W)
+            with TRACE.span("tier_restore"):
+                clients = self._restore_chunk(
+                    clients, plan.restores[lo:lo + W], W)
         return clients
 
     def _spill_chunk(self, clients, chunk, W: int) -> None:

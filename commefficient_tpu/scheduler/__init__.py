@@ -71,6 +71,7 @@ from commefficient_tpu.scheduler.policy import (
     UniformSampler, make_sampler,
 )
 from commefficient_tpu.telemetry.clients import ClientThroughputTracker
+from commefficient_tpu.telemetry.trace import TRACE
 
 __all__ = [
     "DeadlineDecision", "DeadlinePolicy", "ParticipantSampler",
@@ -218,7 +219,11 @@ class RoundScheduler:
         from commefficient_tpu.parallel.plantransport import (
             deserialize_plan,
         )
-        plan = deserialize_plan(self.transport.broadcast(round_idx))
+        # graftscope: the follower's blocking wait on the
+        # coordinator's broadcast IS the plan_install stage here
+        with TRACE.span("plan_install", round=int(round_idx)):
+            plan = deserialize_plan(
+                self.transport.broadcast(round_idx))
         self._received = plan
         return plan
 
@@ -382,9 +387,10 @@ class RoundScheduler:
                 deserialize_plan,
             )
             self._last_selected = None
-            delivered = self.transport.broadcast(round_idx, wire)
-            self._install(round_idx, deserialize_plan(delivered),
-                          fresh)
+            with TRACE.span("plan_install", round=int(round_idx)):
+                delivered = self.transport.broadcast(round_idx, wire)
+                self._install(round_idx, deserialize_plan(delivered),
+                              fresh)
             return
         active = ex > 0
         n_active = int(active.sum())
@@ -420,10 +426,11 @@ class RoundScheduler:
             from commefficient_tpu.parallel.plantransport import (
                 deserialize_plan, serialize_plan,
             )
-            delivered = self.transport.broadcast(
-                round_idx, serialize_plan(plan))
-            self._install(round_idx, deserialize_plan(delivered),
-                          fresh=False)
+            with TRACE.span("plan_install", round=int(round_idx)):
+                delivered = self.transport.broadcast(
+                    round_idx, serialize_plan(plan))
+                self._install(round_idx, deserialize_plan(delivered),
+                              fresh=False)
             return
         self._plans[round_idx] = plan
 

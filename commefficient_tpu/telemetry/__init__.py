@@ -42,11 +42,12 @@ from commefficient_tpu.analysis import runtime as _runtime
 from commefficient_tpu.telemetry import metrics as tmetrics
 from commefficient_tpu.telemetry.clients import ClientThroughputTracker
 from commefficient_tpu.telemetry.journal import RunJournal, append_event
+from commefficient_tpu.telemetry.trace import TRACE
 
 __all__ = [
-    "ClientThroughputTracker", "RunJournal", "TelemetrySession",
-    "append_event", "attach_run_telemetry", "parse_profile_spans",
-    "tmetrics",
+    "ClientThroughputTracker", "RunJournal", "TRACE",
+    "TelemetrySession", "append_event", "attach_run_telemetry",
+    "parse_profile_spans", "tmetrics",
 ]
 
 
@@ -98,10 +99,16 @@ def attach_run_telemetry(model, cfg, log_dir: str, coord: bool,
         journal=journal, tracker=model.throughput,
         profile_spans=cfg.profile_spans,
         profile_dir=os.path.join(log_dir or ".", "profile_spans"),
-        materialize=materialize)
+        materialize=materialize,
+        # graftscope (ISSUE 13): --trace enables the process-global
+        # stage tracer for this run (session-owned; disabled at
+        # close); the controller tag keys cross-controller stitching
+        trace=bool(getattr(cfg, "trace", False)),
+        controller=jax.process_index())
     model.attach_telemetry(tele)
     tele.journal_event(
         "run_start", driver=driver, mode=cfg.mode,
+        trace=bool(getattr(cfg, "trace", False)),
         dataset=cfg.dataset_name, num_workers=cfg.num_workers,
         num_clients=model.num_clients, grad_size=model.cfg.grad_size,
         # compression-kernel provenance (ISSUE 6): a journal reader
@@ -142,9 +149,18 @@ class TelemetrySession:
                  profile_spans: str = "",
                  profile_dir: str = "profile_spans",
                  materialize: Callable = jax.device_get,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 trace: bool = False, controller: int = 0):
         self.journal = journal
         self.tracker = tracker
+        # graftscope (ISSUE 13, --trace): enable the process-global
+        # tracer for this run; the session owns it — drained at every
+        # round/span boundary into batched `trace` journal events and
+        # DISABLED again at close, so tracing never leaks into a
+        # later in-process run
+        self._owns_trace = bool(trace)
+        if trace:
+            TRACE.enable(controller=controller)
         self._materialize = materialize
         self._clock = clock
         self._spans = parse_profile_spans(profile_spans)
@@ -186,6 +202,26 @@ class TelemetrySession:
     def journal_event(self, kind: str, **fields) -> None:
         if self.journal is not None:
             self._safe_write(lambda: self.journal.event(kind, **fields))
+
+    def _flush_trace(self) -> None:
+        """Drain the graftscope rings into ONE batched `trace` journal
+        event (span-boundary flush cadence: one append+fsync per
+        flush, not per span). Without a journal (non-coordinator
+        processes) the drain still runs so the rings stay bounded —
+        the spans are simply discarded, like every other
+        coordinator-only record."""
+        if not TRACE.enabled:
+            return
+        spans, dropped = TRACE.drain()
+        if not spans and not dropped:
+            return
+        if self.journal is None:
+            return
+        fields = {"controller": TRACE.controller, "spans": spans}
+        if dropped:
+            fields["dropped"] = int(dropped)
+        self._safe_write(
+            lambda: self.journal.event("trace", **fields))
 
     # ---------------- compile events (analysis/runtime listener) ---------
     def mark_steady_state(self) -> None:
@@ -270,6 +306,9 @@ class TelemetrySession:
             self.journal_event("round", **fields)
         elif comm is not None:
             self._record_comm({}, comm)
+        # per-round boundary = the unscanned path's span boundary:
+        # flush the stage spans this round produced as one batch
+        self._flush_trace()
 
     def flush(self) -> None:
         """Drain the one-round-lag buffer (end of epoch/run; before a
@@ -282,6 +321,7 @@ class TelemetrySession:
         prev, self._pending = self._pending, None
         if prev is not None:
             self._emit_round(prev, None)
+        self._flush_trace()
         if self.journal is not None:
             self._safe_write(self.journal.flush)
 
@@ -345,6 +385,10 @@ class TelemetrySession:
         elif comm_rows is not None:
             for comm in comm_rows:
                 self._record_comm({}, comm)
+        # span-boundary graftscope flush: the span's stage spans (and
+        # any writer-thread spans committed since the last boundary)
+        # land as one batched trace event — one additional fsync
+        self._flush_trace()
 
     # ---------------- profiler capture (--profile_spans) -----------------
     def span_profile_begin(self, span_idx: int) -> None:
@@ -395,3 +439,7 @@ class TelemetrySession:
                 fields.setdefault("up_bytes_total", self._cum_up_bytes)
             self.journal_event("run_end", **fields)
             self.journal.close()
+        if self._owns_trace:
+            # the session enabled the global tracer; a leaked enable
+            # would trace (and buffer) every later in-process run
+            TRACE.disable()

@@ -3,7 +3,13 @@ dir.
 
 Every record is one JSON object per line with three mandatory fields —
 `v` (schema version), `event` (record kind), `ts` (wall-clock epoch
-seconds) — plus kind-specific payload. One schema serves every
+seconds) — plus a monotonic-clock `mono` twin of `ts` and
+kind-specific payload. `ts` is for humans and cross-machine
+correlation; `mono` is what DURATIONS are derived from (inter-record
+deltas of `ts` are not durations — an NTP step mid-run corrupts them;
+graftlint GL011 holds that line in code, `mono` holds it in the
+record format). `mono` values share a base only within one process
+lifetime: consumers must reset delta tracking at each `run_start`. One schema serves every
 producer: training runs (round/span metrics, checkpoint saves, XLA
 compile events, retry attempts, injected faults), bench harnesses
 (bench.py / benchmarks/profile_round.py append their digests as
@@ -54,6 +60,16 @@ consumers must tolerate kinds they don't know):
   injected_fault          a utils/faults InjectedFault about to raise
   profile_start / profile_stop   jax.profiler capture of operator-
                           selected spans (--profile_spans)
+  trace                   one batched flush of graftscope stage spans
+                          (ISSUE 13, telemetry/trace.py): `spans` is a
+                          list of {name, t0 (monotonic s), dur,
+                          thread, ...correlation tags}, `controller`
+                          the recording controller, `dropped` the
+                          ring-overflow count — the record
+                          scripts/trace_export.py turns into a
+                          Perfetto-loadable Chrome trace and
+                          summarize() turns into per-stage p50/p95 +
+                          overlap efficiency
   bench_digest / profile_digest  bench harness result records
   audit_digest            graftaudit's static cost report
                           (analysis/audit): sha256 `digest`,
@@ -76,6 +92,9 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from commefficient_tpu.telemetry.trace import (
+    TRACE, device_busy_wall, stage_stats,
+)
 from commefficient_tpu.utils.atomic_io import atomic_append_lines
 
 SCHEMA_VERSION = 1
@@ -148,11 +167,17 @@ class RunJournal:
 
     def __init__(self, path: str, run_id: str = "",
                  clock: Callable[[], float] = time.time,
+                 mono_clock: Callable[[], float] = time.monotonic,
                  async_writer: bool = False, max_queue: int = 256,
                  drain_timeout: float = 0.0):
         self.path = path
         self.run_id = run_id
         self._clock = clock
+        self._mono = mono_clock
+        # graftscope correlation (ISSUE 13): per-journal submission
+        # sequence — an async append's producer-side enqueue instant
+        # and its writer-thread qwait/write spans share a `seq`
+        self._seq = 0
         # writer-thread watchdog (ISSUE 12 satellite): flush()/close()
         # deadline in seconds; 0 = wait forever (the old behavior)
         self._drain_timeout = float(drain_timeout)
@@ -171,8 +196,13 @@ class RunJournal:
             self._thread.start()
 
     def _record(self, kind: str, fields: dict) -> dict:
+        # dual timestamps (ISSUE 13 satellite): `ts` stays the human/
+        # cross-machine wall clock, `mono` is the monotonic twin every
+        # duration derivation (cadence stats, bench gap histograms)
+        # must use — wall-clock deltas are NTP-step-hazardous
         rec = {"v": SCHEMA_VERSION, "event": str(kind),
-               "ts": round(float(self._clock()), 6)}
+               "ts": round(float(self._clock()), 6),
+               "mono": round(float(self._mono()), 6)}
         if self.run_id:
             rec["run_id"] = self.run_id
         rec.update(fields)
@@ -190,9 +220,21 @@ class RunJournal:
             try:
                 if item is self._SENTINEL:
                     return
-                lines, check_tail = item
+                lines, check_tail, enq_mono, seq, tags = item
+                if enq_mono is not None:
+                    # queue-wait span: enqueue -> dequeue, the
+                    # back-pressure interval graftscope charges to
+                    # this writer (same `seq` as the producer-side
+                    # journal_enqueue instant)
+                    TRACE.record("journal_qwait", enq_mono,
+                                 time.monotonic(), seq=seq, **tags)
                 try:
-                    self._append(lines, check_tail)
+                    if enq_mono is not None:
+                        with TRACE.span("journal_write", seq=seq,
+                                        **tags):
+                            self._append(lines, check_tail)
+                    else:
+                        self._append(lines, check_tail)
                 except (OSError, ValueError) as e:
                     # best-effort like the sync path's _safe_write
                     # wrapper: observability must never kill training
@@ -203,18 +245,50 @@ class RunJournal:
             finally:
                 q.task_done()
 
-    def _emit(self, lines) -> None:
+    def _emit(self, lines, trace_tags: Optional[dict] = None) -> None:
+        """Write or enqueue serialized lines. `trace_tags`: graftscope
+        correlation tags ({} = trace with no tags, None = do NOT trace
+        this append — the flush of `trace` events themselves, which
+        would otherwise self-generate one span per flush forever)."""
         check_tail = not self._tail_checked
         self._tail_checked = True
+        traced = trace_tags is not None and TRACE.enabled
         if self._q is None:
-            self._append(lines, check_tail)
+            if traced:
+                with TRACE.span("journal_write", **trace_tags):
+                    self._append(lines, check_tail)
+            else:
+                self._append(lines, check_tail)
+            return
+        if traced:
+            seq, self._seq = self._seq, self._seq + 1
+            TRACE.instant("journal_enqueue", seq=seq,
+                          q=self._q.qsize(), **trace_tags)
+            self._q.put((list(lines), check_tail,
+                         time.monotonic(), seq, dict(trace_tags)))
         else:
-            self._q.put((list(lines), check_tail))
+            self._q.put((list(lines), check_tail, None, 0, {}))
+
+    @staticmethod
+    def _tags_of(recs) -> Optional[dict]:
+        """Correlation tags for one append: the first record's round
+        index (round or first_round), or untagged. `trace` records
+        return None — their own appends are never traced (see
+        _emit)."""
+        if any(r.get("event") == "trace" for r in recs):
+            return None
+        for r in recs:
+            for key in ("round", "first_round"):
+                v = r.get(key)
+                if isinstance(v, int):
+                    return {"round": v}
+        return {}
 
     def event(self, kind: str, **fields) -> dict:
         """Append one record; returns the dict that was written."""
         rec = self._record(kind, fields)
-        self._emit((json.dumps(_finite(rec), default=_jsonable),))
+        self._emit((json.dumps(_finite(rec), default=_jsonable),),
+                   trace_tags=self._tags_of((rec,)))
         return rec
 
     def events(self, batch) -> List[dict]:
@@ -226,7 +300,8 @@ class RunJournal:
         batch rides the queue as ONE item — still one fsync."""
         recs = [self._record(kind, fields) for kind, fields in batch]
         self._emit([json.dumps(_finite(r), default=_jsonable)
-                    for r in recs])
+                    for r in recs],
+                   trace_tags=self._tags_of(recs))
         return recs
 
     def flush(self) -> None:
@@ -340,6 +415,14 @@ def validate_journal(path: str,
         non-negative integer hits/misses/spills/restores and
         non-negative spill_bytes/restore_bytes/resident/working_set —
         the residency record the BENCH_r11 working-set table reads;
+      * `trace` events (graftscope, telemetry/trace.py) carry a list
+        `spans` of objects each with a string `name`, string
+        `thread`, numeric non-negative `t0` (monotonic seconds) and
+        `dur`; optional `dropped` must be a non-negative integer —
+        the record trace_export.py and the stage analytics read, so
+        its shape must not rot;
+      * `mono` (when present) is a non-negative number — the
+        monotonic twin of `ts` durations are derived from;
       * `audit_digest` events (graftaudit cost reports) carry a
         non-empty string `digest` and a `programs` object mapping each
         audited program to non-negative numeric flops/hbm_bytes — the
@@ -393,6 +476,44 @@ def validate_journal(path: str,
                 f"record {n}: schema version {v!r} != {SCHEMA_VERSION}")
         if not isinstance(rec.get("ts", 0.0), (int, float)):
             problems.append(f"record {n}: non-numeric `ts`")
+        mono = rec.get("mono")
+        if mono is not None and not (isinstance(mono, (int, float))
+                                     and mono >= 0):
+            problems.append(
+                f"record {n}: `mono` must be a non-negative number "
+                f"(got {mono!r})")
+        if rec.get("event") == "trace":
+            spans = rec.get("spans")
+            if not isinstance(spans, list):
+                problems.append(
+                    f"record {n}: trace event `spans` is not a list")
+            else:
+                for j, sp in enumerate(spans):
+                    if not isinstance(sp, dict):
+                        problems.append(
+                            f"record {n}: trace span {j} is not an "
+                            "object")
+                        continue
+                    for field in ("name", "thread"):
+                        if not isinstance(sp.get(field), str):
+                            problems.append(
+                                f"record {n}: trace span {j} "
+                                f"`{field}` must be a string (got "
+                                f"{sp.get(field)!r})")
+                    for field in ("t0", "dur"):
+                        v2 = sp.get(field)
+                        if not (isinstance(v2, (int, float))
+                                and v2 >= 0):
+                            problems.append(
+                                f"record {n}: trace span {j} "
+                                f"`{field}` must be a non-negative "
+                                f"number (got {v2!r})")
+            d2 = rec.get("dropped")
+            if d2 is not None and not (isinstance(d2, int)
+                                       and d2 >= 0):
+                problems.append(
+                    f"record {n}: trace `dropped` must be a "
+                    f"non-negative integer (got {d2!r})")
         if rec.get("event") == "schedule":
             if not isinstance(rec.get("round"), int):
                 problems.append(
@@ -498,6 +619,23 @@ def validate_journal(path: str,
     return records, problems
 
 
+# inter-round cadence histogram buckets (seconds): log-ish edges with
+# human labels — coarse on purpose (the p50/p95 carry the precision;
+# the histogram shows the SHAPE: bimodal cadence = a periodic stall)
+_CADENCE_EDGES = (
+    (0.001, "<1ms"), (0.003, "1-3ms"), (0.01, "3-10ms"),
+    (0.03, "10-30ms"), (0.1, "30-100ms"), (0.3, "0.1-0.3s"),
+    (1.0, "0.3-1s"), (3.0, "1-3s"), (10.0, "3-10s"),
+)
+
+
+def _cadence_bucket(dt: float) -> str:
+    for edge, label in _CADENCE_EDGES:
+        if dt < edge:
+            return label
+    return ">=10s"
+
+
 def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     """Small host-side digest of a journal: event-kind counts, round
     coverage, total journaled wall time in spans/checkpoints.
@@ -505,7 +643,18 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     read_journal/validate_journal's `counters` — surfaced in the
     summary (ISSUE 12 satellite) so a journal that survived a
     mid-batch writer crash says so instead of silently looking
-    clean."""
+    clean.
+
+    Stage-level analytics (ISSUE 13, graftscope): with `trace` events
+    present the summary grows per-stage p50/p95 (`trace_stages`), the
+    writer queue-depth gauges (`writer_queue_max`, from the enqueue
+    spans' `q` tags), and the pipeline overlap-efficiency metric
+    (`overlap_efficiency` = device-busy / wall over the
+    device_execute spans). Independently, round events carrying the
+    `mono` timestamp yield the inter-round `cadence` block
+    (p50/p95 + histogram) — deltas are taken on the MONOTONIC clock,
+    reset at every run_start (each process has its own mono base,
+    and a wall-clock delta is not a duration)."""
     kinds: dict = {}
     rounds = []
     span_s = ckpt_s = 0.0
@@ -513,9 +662,31 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     deadlines = 0
     tier_hits = tier_misses = tier_spills = 0
     tier_spill_b = 0.0
+    # trace spans SEGMENTED at run_start: monotonic t0 values share a
+    # base only within one process lifetime, so the wall-extent math
+    # (overlap efficiency) must never mix segments from a resumed run
+    # or a coordinator takeover
+    trace_segments: List[List[dict]] = [[]]
+    trace_dropped = 0
+    cadence: List[float] = []
+    prev_mono = None
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "run_start":
+            # new segment: a resumed process has its own monotonic
+            # base, so cross-segment deltas are meaningless
+            prev_mono = None
+            if trace_segments[-1]:
+                trace_segments.append([])
+        if kind == "trace":
+            spans = rec.get("spans")
+            if isinstance(spans, list):
+                trace_segments[-1].extend(
+                    sp for sp in spans if isinstance(sp, dict))
+            d = rec.get("dropped")
+            if isinstance(d, int) and d > 0:
+                trace_dropped += d
         if kind == "state_tier":
             tier_hits += int(rec.get("hits", 0) or 0)
             tier_misses += int(rec.get("misses", 0) or 0)
@@ -523,6 +694,11 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
             tier_spill_b += float(rec.get("spill_bytes", 0) or 0)
         if kind == "round" and isinstance(rec.get("round"), int):
             rounds.append(rec["round"])
+            mono = rec.get("mono")
+            if isinstance(mono, (int, float)):
+                if prev_mono is not None and mono > prev_mono:
+                    cadence.append(float(mono) - prev_mono)
+                prev_mono = float(mono)
             if isinstance(rec.get("down_bytes"), (int, float)):
                 down_b += float(rec["down_bytes"])
             if isinstance(rec.get("up_bytes"), (int, float)):
@@ -553,6 +729,46 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
             tier_hits / max(tier_hits + tier_misses, 1), 4)
         out["state_spills"] = tier_spills
         out["state_spill_mib"] = round(tier_spill_b / (1024 ** 2), 3)
+    if cadence:
+        hist: dict = {}
+        for dt in cadence:
+            label = _cadence_bucket(dt)
+            hist[label] = hist.get(label, 0) + 1
+        srt = sorted(cadence)
+        out["cadence"] = {
+            "rounds": len(cadence),
+            "p50_s": round(srt[min(len(srt) // 2, len(srt) - 1)], 6),
+            "p95_s": round(
+                srt[min(int(0.95 * len(srt)), len(srt) - 1)], 6),
+            "hist": hist,
+        }
+    trace_spans = [sp for seg in trace_segments for sp in seg]
+    if trace_spans:
+        # graftscope (ISSUE 13): the stage-level analytics block.
+        # Stage durations pool across segments (each dur is already a
+        # within-process interval); busy/wall sums PER segment.
+        out["trace_spans"] = len(trace_spans)
+        out["trace_stages"] = stage_stats(trace_spans)
+        busy = wall = 0.0
+        for seg in trace_segments:
+            bw = device_busy_wall(seg)
+            if bw is not None:
+                busy += bw[0]
+                wall += bw[1]
+        if wall > 0:
+            out["overlap_efficiency"] = round(min(busy / wall, 1.0), 4)
+        qmax: dict = {}
+        for sp in trace_spans:
+            q = sp.get("q")
+            name = sp.get("name", "")
+            if isinstance(q, int) and isinstance(name, str) \
+                    and name.endswith("_enqueue"):
+                writer = name[:-len("_enqueue")]
+                qmax[writer] = max(qmax.get(writer, 0), q)
+        if qmax:
+            out["writer_queue_max"] = dict(sorted(qmax.items()))
+        if trace_dropped:
+            out["trace_dropped"] = trace_dropped
     if corrupt_lines:
         out["corrupt_lines"] = int(corrupt_lines)
     return out

@@ -46,6 +46,7 @@ from commefficient_tpu.utils.checkpoint import (
     latest_checkpoint_path, load_checkpoint, load_resilient,
     save_final, save_rotating, transfer_for_finetune,
 )
+from commefficient_tpu.telemetry.trace import TRACE
 from commefficient_tpu.utils.logging import (
     TableLogger, Timer, make_logdir,
 )
@@ -398,19 +399,20 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
             # queued span-boundary writes (--pipeline) must land
             # before this synchronous save rotates the manifest
             model.drain_persistence()
-            path = save_rotating(
-                _ckpt_path(cfg), model.server, model.clients,
-                keep_last=cfg.keep_checkpoints,
-                max_age_hours=cfg.ckpt_max_age_hours,
-                scheduler_step=lr_scheduler.step_count,
-                accountant=model.accountant,
-                prev_change_words=model._prev_change_words,
-                fingerprint=model.checkpoint_fingerprint,
-                throughput=model.throughput.state_dict(),
-                scheduler=model.scheduler_state(),
-                sampler=model.sampler_state(),
-                async_admit=model.async_admit_state(),
-                client_rows=model.client_rows_payload())
+            with TRACE.span("checkpoint", round=int(rounds_done)):
+                path = save_rotating(
+                    _ckpt_path(cfg), model.server, model.clients,
+                    keep_last=cfg.keep_checkpoints,
+                    max_age_hours=cfg.ckpt_max_age_hours,
+                    scheduler_step=lr_scheduler.step_count,
+                    accountant=model.accountant,
+                    prev_change_words=model._prev_change_words,
+                    fingerprint=model.checkpoint_fingerprint,
+                    throughput=model.throughput.state_dict(),
+                    scheduler=model.scheduler_state(),
+                    sampler=model.sampler_state(),
+                    async_admit=model.async_admit_state(),
+                    client_rows=model.client_rows_payload())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
                     "checkpoint", path=path,
@@ -422,8 +424,12 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
 
 def _now() -> float:
+    # monotonic, not wall clock: every consumer subtracts two _now()
+    # values to form a duration (step timing), and a wall-clock delta
+    # is not a duration — an NTP step mid-epoch would print negative
+    # or wildly wrong step times (graftlint GL011)
     import time
-    return time.time()
+    return time.monotonic()
 
 
 def _try_tensorboard(log_dir):

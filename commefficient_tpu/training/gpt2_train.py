@@ -43,6 +43,7 @@ from commefficient_tpu.models.gpt2 import (
 from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 from commefficient_tpu.parallel.tp import tp_loss
+from commefficient_tpu.telemetry.trace import TRACE
 from commefficient_tpu.training.scanloop import (
     make_span_checkpoint, run_scanned_rounds,
 )
@@ -340,19 +341,22 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
             # queued span-boundary writes (--pipeline) must land
             # before this synchronous save rotates the manifest
             model.drain_persistence()
-            written = save_rotating(
-                ckpt_path, model.server, model.clients,
-                keep_last=cfg.keep_checkpoints,
-                max_age_hours=cfg.ckpt_max_age_hours,
-                scheduler_step=lr_scheduler.step_count,
-                accountant=model.accountant,
-                prev_change_words=model._prev_change_words,
-                fingerprint=model.checkpoint_fingerprint,
-                throughput=model.throughput.state_dict(),
-                scheduler=model.scheduler_state(),
-                sampler=model.sampler_state(),
-                async_admit=model.async_admit_state(),
-                client_rows=model.client_rows_payload())
+            with TRACE.span("checkpoint",
+                            round=int(getattr(model, "_rounds_done",
+                                              0))):
+                written = save_rotating(
+                    ckpt_path, model.server, model.clients,
+                    keep_last=cfg.keep_checkpoints,
+                    max_age_hours=cfg.ckpt_max_age_hours,
+                    scheduler_step=lr_scheduler.step_count,
+                    accountant=model.accountant,
+                    prev_change_words=model._prev_change_words,
+                    fingerprint=model.checkpoint_fingerprint,
+                    throughput=model.throughput.state_dict(),
+                    scheduler=model.scheduler_state(),
+                    sampler=model.sampler_state(),
+                    async_admit=model.async_admit_state(),
+                    client_rows=model.client_rows_payload())
             if model.telemetry is not None:
                 model.telemetry.journal_event(
                     "checkpoint", path=written,

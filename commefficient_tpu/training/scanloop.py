@@ -241,6 +241,7 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
     if not (cfg.checkpoint_every and cfg.ckpt_every_spans):
         return None
     from commefficient_tpu.parallel import multihost as mh
+    from commefficient_tpu.telemetry.trace import TRACE
     from commefficient_tpu.utils.checkpoint import save_rotating
 
     spans_done = [0]
@@ -277,28 +278,35 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
         if snapshot is None:
             snapshot = take_snapshot()
         t0 = time.monotonic()
-        path = save_rotating(
-            prefix, snapshot["server"], snapshot["clients"],
-            keep_last=cfg.keep_checkpoints,
-            max_age_hours=cfg.ckpt_max_age_hours,
-            scheduler_step=snapshot["scheduler_step"],
-            accountant=model.accountant,
-            prev_change_words=model._prev_change_words,
-            fingerprint=model.checkpoint_fingerprint,
-            # pipelined snapshots carry the tracker state the next
-            # span's draws observed (captured post-collect in the
-            # staging loop); the sync path reads live — same value
-            # there, since nothing collected in between
-            throughput=(snapshot["throughput"]
-                        if "throughput" in snapshot
-                        else model.throughput.state_dict()),
-            scheduler=snapshot["scheduler"],
-            sampler=snapshot["sampler"],
-            async_admit=snapshot["async_admit"],
-            client_rows=model.client_rows_payload(
-                clients=snapshot["clients"],
-                tier=snapshot.get("tier")),
-            writer=model.ckpt_writer)
+        # graftscope (ISSUE 13): the boundary save as a `checkpoint`
+        # stage span (gather + serialize, or gather + enqueue under
+        # the async writer — whose own qwait/write spans inherit this
+        # span's round tag through the submit path)
+        with TRACE.span("checkpoint",
+                        round=int(getattr(model, "_rounds_done", 0))):
+            path = save_rotating(
+                prefix, snapshot["server"], snapshot["clients"],
+                keep_last=cfg.keep_checkpoints,
+                max_age_hours=cfg.ckpt_max_age_hours,
+                scheduler_step=snapshot["scheduler_step"],
+                accountant=model.accountant,
+                prev_change_words=model._prev_change_words,
+                fingerprint=model.checkpoint_fingerprint,
+                # pipelined snapshots carry the tracker state the
+                # next span's draws observed (captured post-collect
+                # in the staging loop); the sync path reads live —
+                # same value there, since nothing collected in
+                # between
+                throughput=(snapshot["throughput"]
+                            if "throughput" in snapshot
+                            else model.throughput.state_dict()),
+                scheduler=snapshot["scheduler"],
+                sampler=snapshot["sampler"],
+                async_admit=snapshot["async_admit"],
+                client_rows=model.client_rows_payload(
+                    clients=snapshot["clients"],
+                    tier=snapshot.get("tier")),
+                writer=model.ckpt_writer)
         tele = getattr(model, "telemetry", None)
         if tele is not None:
             # the save is a full state gather + disk write — exactly

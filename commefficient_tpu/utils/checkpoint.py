@@ -46,6 +46,7 @@ import numpy as np
 
 from commefficient_tpu.federated.round import ClientState, ServerState
 from commefficient_tpu.parallel import multihost as mh
+from commefficient_tpu.telemetry.trace import TRACE
 
 # the config fields a checkpoint must agree on to be loadable into a
 # run (order fixed; all serialized as strings in the .npz)
@@ -127,18 +128,34 @@ class AsyncCheckpointWriter:
         # writer", not "checkpoint writer"
         self._drain_timeout = float(drain_timeout)
         self._name = str(name)
+        # graftscope correlation (ISSUE 13): per-writer submission
+        # sequence — the producer-side `<name>_enqueue` instant and
+        # this item's writer-thread `<name>_qwait`/`<name>_write`
+        # spans share a `seq`, stitching the deferred write back to
+        # the round that produced it
+        self._seq = 0
         self._thread = threading.Thread(
             target=self._run, name=f"{name}-writer", daemon=True)
         self._thread.start()
 
     def _run(self) -> None:
+        import time as _time
         while True:
-            job = self._q.get()
+            item = self._q.get()
             try:
-                if job is self._SENTINEL:
+                if item is self._SENTINEL:
                     return
+                job, enq_mono, seq, tags = item
+                if enq_mono is not None:
+                    TRACE.record(f"{self._name}_qwait", enq_mono,
+                                 _time.monotonic(), seq=seq, **tags)
                 try:
-                    job()
+                    if enq_mono is not None:
+                        with TRACE.span(f"{self._name}_write",
+                                        seq=seq, **tags):
+                            job()
+                    else:
+                        job()
                 except BaseException as e:  # graftlint: disable=GL005 -- not swallowed: deferred re-raise on the caller's thread at drain()/submit() (_raise_pending); jobs are write closures, never fault-harness code
                     if self._exc is None:
                         self._exc = e
@@ -158,7 +175,19 @@ class AsyncCheckpointWriter:
         if self._closed:
             raise RuntimeError("AsyncCheckpointWriter is closed")
         self._raise_pending()
-        self._q.put(job)
+        if TRACE.enabled:
+            import time as _time
+            seq, self._seq = self._seq, self._seq + 1
+            # the enqueue instant runs on the PRODUCER thread inside
+            # whatever stage span is open there (checkpoint, or the
+            # tier_spill chunk), so its inherited round tag — carried
+            # into the queue item — labels the writer-thread spans
+            tags = TRACE.current_tags()
+            TRACE.instant(f"{self._name}_enqueue", seq=seq,
+                          q=self._q.qsize(), **tags)
+            self._q.put((job, _time.monotonic(), seq, tags))
+        else:
+            self._q.put((job, None, 0, {}))
 
     def drain(self) -> None:
         """Block until every submitted write is durable; re-raise the

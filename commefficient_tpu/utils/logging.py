@@ -103,12 +103,17 @@ class TSVLogger:
 
 
 class Timer:
+    """Interval timer on the MONOTONIC clock: every read subtracts two
+    stamps to form a duration, and a wall-clock (time.time) delta is
+    not a duration — an NTP step mid-run would report negative or
+    inflated epoch times (graftlint GL011)."""
+
     def __init__(self):
-        self.times = [time.time()]
+        self.times = [time.monotonic()]
         self.total_time = 0.0
 
     def __call__(self, include_in_total=True):
-        self.times.append(time.time())
+        self.times.append(time.monotonic())
         dt = self.times[-1] - self.times[-2]
         if include_in_total:
             self.total_time += dt

@@ -16,6 +16,14 @@ payloads. tier1.sh runs a SECOND smoke under `--sampler throughput
 --deadline_quantile 0.9` so those records are exercised in CI; the
 summary line includes down_mib/up_mib and the deadline-round count.
 
+ISSUE 13 (graftscope): journals from `--trace` runs additionally
+report the stage-level analytics block — per-stage p50/p95 over the
+trace spans (`trace_stages`), the inter-round cadence histogram
+(monotonic `mono` deltas, reset at each `run_start`), writer
+queue-depth gauges (`writer_queue_max`), and `overlap_efficiency`
+(device-busy / wall over the `device_execute` span union). Export the
+same spans to Perfetto with scripts/trace_export.py.
+
 Usage:
     python scripts/journal_summary.py <journal.jsonl> [--quiet]
 

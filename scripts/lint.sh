@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # graftlint: the repo's trace-safety static-analysis pass (rules
-# GL001-GL006, see README "Invariants & graftlint"). Runs from any cwd;
+# GL001-GL011, see README "Invariants & graftlint"). Runs from any cwd;
 # extra args pass through (e.g. `bash scripts/lint.sh --list-rules`,
 # `--no-baseline`, `--write-baseline`).
 #

@@ -64,6 +64,26 @@ if [ "$rc" -eq 0 ]; then
   python scripts/journal_summary.py "$JR" \
       || { echo "JOURNAL_INVALID"; exit 1; }
 
+  # recompile regression gate (ISSUE 13 satellite): after
+  # mark_steady_state every backend compile journals as a
+  # compile_warning — a silent retrace in the steady-state loop is a
+  # TPU performance cliff, so any such event in a driver smoke's
+  # journal fails tier-1 (eval-phase compiles run under
+  # expect_compiles and are exempt by construction).
+  check_no_recompiles() {
+    python - "$1" <<'PYEOF'
+import json, sys
+warns = [json.loads(l) for l in open(sys.argv[1])
+         if '"compile_warning"' in l]
+warns = [w for w in warns if w.get("event") == "compile_warning"]
+assert not warns, (
+    f"{len(warns)} steady-state recompile(s) journaled in "
+    f"{sys.argv[1]}: " + "; ".join(
+        str(w.get("what", "?")) for w in warns[:5]))
+PYEOF
+  }
+  check_no_recompiles "$JR" || { echo "STEADY_STATE_RECOMPILE"; exit 1; }
+
   # scheduled-driver smoke (ISSUE 5 satellite): the same tiny scanned
   # run under throughput-aware sampling + a 0.9-quantile deadline; its
   # journal (schedule events, per-round byte totals) must pass the
@@ -82,6 +102,7 @@ if [ "$rc" -eq 0 ]; then
       || { echo "SCHEDULED_SMOKE_FAILED"; exit 1; }
   python scripts/journal_summary.py "$JR2" \
       || { echo "SCHED_JOURNAL_INVALID"; exit 1; }
+  check_no_recompiles "$JR2" || { echo "SCHED_RECOMPILE"; exit 1; }
 
   # Pallas kernel-backend gate (ISSUE 6 satellite). Two parts:
   # (1) the `pallas` marker suite alone — the kernels' interpret-mode
@@ -120,8 +141,12 @@ if [ "$rc" -eq 0 ]; then
   # runs end-to-end. The journal it writes (round/span/checkpoint
   # events from the one-span-late commit path) must pass the same
   # invariant check, so the pipelined record stream cannot rot.
+  # ISSUE 13 rides the same smoke with --trace: the graftscope spans
+  # must validate, export to well-formed Chrome trace JSON covering
+  # >= 5 distinct stages across >= 3 threads, and the summary must
+  # report per-stage p50/p95 plus a nonzero overlap efficiency.
   JR5=/tmp/_t1_journal_pipe.jsonl
-  rm -f "$JR5"
+  rm -f "$JR5" "$JR5.trace.json"
   rm -rf /tmp/_t1_pipe_ckpt
   timeout -k 10 300 env JAX_PLATFORMS=cpu \
       XLA_FLAGS="--xla_force_host_platform_device_count=8" \
@@ -132,11 +157,35 @@ if [ "$rc" -eq 0 ]; then
       --scan_rounds --scan_span 1 --pipeline --async_admit_rounds 1 \
       --straggler_rate 0.6 --straggler_min_work 0.4 \
       --checkpoint --checkpoint_every 1 \
-      --checkpoint_path /tmp/_t1_pipe_ckpt \
+      --checkpoint_path /tmp/_t1_pipe_ckpt --trace \
       --journal_path "$JR5" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
       || { echo "PIPELINE_SMOKE_FAILED"; exit 1; }
   python scripts/journal_summary.py "$JR5" \
       || { echo "PIPELINE_JOURNAL_INVALID"; exit 1; }
+  check_no_recompiles "$JR5" || { echo "PIPELINE_RECOMPILE"; exit 1; }
+  python scripts/trace_export.py "$JR5" -o "$JR5.trace.json" \
+      || { echo "TRACE_EXPORT_FAILED"; exit 1; }
+  python - "$JR5" "$JR5.trace.json" <<'PYEOF' || { echo "TRACE_GATE_FAILED"; exit 1; }
+import json, sys
+sys.path.insert(0, ".")
+from commefficient_tpu.telemetry.journal import summarize, validate_journal
+records, problems = validate_journal(sys.argv[1])
+assert not problems, problems
+s = summarize(records)
+assert s.get("trace_spans", 0) > 0, "no graftscope spans journaled"
+stages = s.get("trace_stages", {})
+assert all("p50_s" in v and "p95_s" in v for v in stages.values())
+oe = s.get("overlap_efficiency")
+assert oe is not None and oe > 0, f"overlap efficiency not measured: {oe}"
+trace = json.load(open(sys.argv[2]))
+xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+names = {e["name"] for e in xs}
+threads = {(e["pid"], e["tid"]) for e in xs}
+assert len(names) >= 5, f"only {len(names)} stages exported: {sorted(names)}"
+assert len(threads) >= 3, f"only {len(threads)} threads in trace"
+print(f"TRACE_GATE_OK stages={len(names)} threads={len(threads)} "
+      f"overlap_efficiency={oe}")
+PYEOF
 
   # multi-controller control-plane smoke (ISSUE 12): the scheduled
   # scanned run under the EMULATED N-controller plan transport —

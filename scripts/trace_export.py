@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Export a run journal's graftscope trace events as Chrome
+trace-event JSON (loadable in Perfetto / chrome://tracing).
+
+The journal's `trace` records (telemetry/trace.py, --trace) each carry
+a batch of stage spans with MONOTONIC timestamps plus the record's own
+`ts` (wall) / `mono` (monotonic) pair. The exporter maps every span
+onto the wall clock with that record's own offset (ts - mono), so
+spans from different processes — a resumed run, a coordinator takeover
+(ISSUE 12) — land on one shared timeline even though each process has
+its own monotonic base.
+
+Row layout: one Perfetto process per controller (`controller N`), one
+thread row per recording thread (MainThread, journal-writer,
+checkpoint-writer, state-spill-writer, ...). Complete events ("ph":
+"X") carry the correlation tags (round / span / seq / q) in `args`;
+writer queue depths additionally export as counter tracks ("ph": "C")
+so back-pressure is visible as a graph, not just per-event args.
+
+Usage:
+    python scripts/trace_export.py <journal.jsonl> [-o out.json]
+
+Exit codes: 0 wrote a trace, 1 journal has no trace events, 2
+unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_US = 1e6  # seconds -> microseconds (the trace-event time unit)
+
+
+def _iter_records(path: str):
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail / corrupt interior: skip
+            if isinstance(rec, dict):
+                yield rec
+
+
+def export_trace(records) -> dict:
+    """Build the Chrome trace-event object from journal records.
+    Returns {"traceEvents": [...], ...}; traceEvents is empty when the
+    journal has no trace records (the caller decides how loud to be).
+    """
+    events = []
+    threads = {}  # (pid, thread name) -> tid int
+    pids = set()
+    t_min = None
+
+    spans = []  # (wall_t0 s, dur s, pid, thread, name, tags)
+    for rec in records:
+        if rec.get("event") != "trace":
+            continue
+        batch = rec.get("spans")
+        if not isinstance(batch, list):
+            continue
+        ts, mono = rec.get("ts"), rec.get("mono")
+        if not (isinstance(ts, (int, float))
+                and isinstance(mono, (int, float))):
+            continue
+        offset = float(ts) - float(mono)  # this process's mono->wall
+        pid = int(rec.get("controller", 0) or 0)
+        pids.add(pid)
+        for sp in batch:
+            if not isinstance(sp, dict):
+                continue
+            t0, dur = sp.get("t0"), sp.get("dur")
+            name, thread = sp.get("name"), sp.get("thread")
+            if not (isinstance(t0, (int, float))
+                    and isinstance(dur, (int, float))
+                    and isinstance(name, str)
+                    and isinstance(thread, str)):
+                continue
+            wall = float(t0) + offset
+            t_min = wall if t_min is None else min(t_min, wall)
+            tags = {k: v for k, v in sp.items()
+                    if k not in ("name", "t0", "dur", "thread")}
+            spans.append((wall, float(dur), pid, thread, name, tags))
+
+    # explicit sort key: two instants can tie on every scalar field,
+    # and tuple comparison must never fall through to the tags dicts
+    spans.sort(key=lambda s: s[:5])
+    for wall, dur, pid, thread, name, tags in spans:
+        tid = threads.setdefault((pid, thread), len(threads) + 1)
+        ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
+              "ts": round((wall - t_min) * _US, 3),
+              "dur": round(dur * _US, 3)}
+        if tags:
+            ev["args"] = tags
+        events.append(ev)
+        # writer queue depth at enqueue -> a counter track per writer
+        if name.endswith("_enqueue") and isinstance(tags.get("q"), int):
+            events.append({
+                "name": f"{name[:-len('_enqueue')]} queue depth",
+                "ph": "C", "pid": pid,
+                "ts": round((wall - t_min) * _US, 3),
+                "args": {"depth": tags["q"]}})
+
+    meta = []
+    for pid in sorted(pids):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"controller {pid}"}})
+    for (pid, thread), tid in sorted(threads.items(),
+                                     key=lambda kv: kv[1]):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": thread}})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("journal", help="path to a journal.jsonl")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: <journal>.trace.json)")
+    args = p.parse_args(argv)
+
+    try:
+        trace = export_trace(_iter_records(args.journal))
+    except OSError as e:
+        print(f"trace_export: cannot read {args.journal!r}: {e}",
+              file=sys.stderr)
+        return 2
+
+    n = sum(1 for ev in trace["traceEvents"] if ev.get("ph") == "X")
+    if n == 0:
+        print("trace_export: no trace events in journal (run with "
+              "--trace)", file=sys.stderr)
+        return 1
+
+    out = args.out or (args.journal + ".trace.json")
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    threads = {(ev["pid"], ev["tid"]) for ev in trace["traceEvents"]
+               if ev.get("ph") == "X"}
+    stages = {ev["name"] for ev in trace["traceEvents"]
+              if ev.get("ph") == "X"}
+    print(f"trace_export: {n} spans, {len(stages)} stages, "
+          f"{len(threads)} threads -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -260,6 +260,34 @@ GL010_NEG = """
         return str(x) + "tpu:0"
 """
 
+GL011_POS = """
+    import time
+
+    def step_time():
+        t0 = time.time()
+        do_work()
+        # both operands wall-clock-derived: an NTP step mid-interval
+        # makes this negative or wildly wrong
+        return time.time() - t0
+"""
+GL011_NEG = """
+    import os, time
+
+    def step_time(t0):
+        # monotonic deltas ARE durations
+        return time.monotonic() - t0
+
+    def checkpoint_age(path):
+        # wall clock vs an EXTERNAL wall-clock value (file mtime):
+        # legitimately wall-clock, not a flagged delta
+        return time.time() - os.path.getmtime(path)
+
+    def timestamp():
+        # a bare reading (no subtraction) is a timestamp, not a
+        # duration
+        return time.time()
+"""
+
 # rule -> (positive, negative[, lint path]); GL010 is path-scoped to
 # the packages that construct shardings, so its fixtures lint under a
 # parallel/ path (everything else uses the default snippet.py)
@@ -275,6 +303,7 @@ FIXTURES = {
     "GL009": (GL009_POS, GL009_NEG),
     "GL010": (GL010_POS, GL010_NEG,
               "commefficient_tpu/parallel/snippet.py"),
+    "GL011": (GL011_POS, GL011_NEG),
 }
 
 
@@ -360,6 +389,25 @@ def test_gl010_shipped_registry():
     )
     assert MESH_AXES == (CLIENTS_AXIS, MODEL_AXIS) == ("clients",
                                                        "model")
+
+
+def test_gl011_scope_is_per_function():
+    """A name bound from time.time() in ONE function must not taint
+    the same name used as an ordinary parameter in another (the
+    module-scope pass prunes nested function bodies)."""
+    src = """
+        import time
+
+        def a():
+            t0 = time.time()
+            return t0
+
+        def b(t0):
+            # t0 here is an external wall-clock value (caller-supplied
+            # timestamp): comparing against the wall clock is legal
+            return time.time() - t0
+    """
+    assert "GL011" not in _fixture_codes(src)
 
 
 def test_every_rule_documented():

@@ -615,10 +615,12 @@ def test_async_journal_identical_records(tmp_path):
     byte-identical files (fixed clock), both validate, and close()
     drains the queue."""
     clock = lambda: 123.0
+    mono = lambda: 45.0  # the `mono` twin must be pinned too
     sync_p = str(tmp_path / "sync.jsonl")
     asyn_p = str(tmp_path / "async.jsonl")
-    js = RunJournal(sync_p, run_id="r", clock=clock)
-    ja = RunJournal(asyn_p, run_id="r", clock=clock, async_writer=True)
+    js = RunJournal(sync_p, run_id="r", clock=clock, mono_clock=mono)
+    ja = RunJournal(asyn_p, run_id="r", clock=clock, mono_clock=mono,
+                    async_writer=True)
     for j in (js, ja):
         j.event("run_start", driver="t")
         j.events([("round", {"round": 0, "seconds": 0.1}),
