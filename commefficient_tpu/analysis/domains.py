@@ -1,4 +1,6 @@
-"""Central registries: PRNG-domain tags and mesh axis names.
+"""Central registries: PRNG-domain tags, mesh axis names, and the
+host-concurrency contracts (shared-state guards + durability-ordering
+edges) graftsync enforces.
 
 The engine's determinism story rests on DOMAIN SEPARATION: the dropout,
 straggler, and scheduler draws are each a pure function of
@@ -79,3 +81,118 @@ MESH_AXES = (CLIENTS_AXIS, MODEL_AXIS)
 
 assert len(set(MESH_AXES)) == len(MESH_AXES), (
     "duplicate axis name in analysis/domains.MESH_AXES")
+
+
+# ---------------------------------------------------------------------------
+# shared-state guard registry (ISSUE 14; enforced by graftsync SY001)
+#
+# The host control plane is thread-rich since PRs 10-13: the journal /
+# checkpoint / spill bounded-queue writer threads, pipelined staging,
+# and the per-thread trace rings all mutate state that another thread
+# reads. The discipline — "this attribute is only touched under that
+# lock" — lived in docstrings; this registry is the ONE place it is
+# declared, and graftsync SY001 holds the line mechanically: a
+# mutation of a registered `Class.attr` outside a `with self.<guard>:`
+# block is an audit error, and an attribute the cross-thread scan
+# proves shared (mutated both from a thread-entry function and from
+# outside one) that is NOT registered is an error too — new shared
+# state must be declared here with its guard, exactly like a new PRNG
+# stream must be declared in DOMAINS.
+#
+# "Class.attr" -> guard lock attribute on the same instance.
+SHARED_STATE = {
+    # telemetry/trace.py — per-thread span rings, appended by every
+    # producing thread (incl. the writer threads), drained by the
+    # flush path
+    "Tracer._rings": "_lock",
+    "Tracer._dropped": "_lock",
+    # federated/statestore.py — the spill writer commits to the tail
+    # and retires pending entries while producers read/restore rows
+    "TieredStateStore._tail": "_lock",
+    "TieredStateStore._pending": "_lock",
+    "TieredStateStore._warm": "_lock",
+    # utils/checkpoint.py — the deferred writer failure is stored on
+    # the writer thread and consumed (cleared) on the caller's thread
+    "AsyncCheckpointWriter._exc": "_exc_lock",
+}
+
+assert all(g for g in SHARED_STATE.values()), (
+    "every SHARED_STATE entry must name its guard lock attribute")
+
+
+# ---------------------------------------------------------------------------
+# durability-ordering registry (ISSUE 14; enforced by graftsync SY006)
+#
+# The control plane's crash-safety and resume-bit-exactness rest on a
+# handful of happens-before edges between host calls — "the write-
+# ahead journal flush runs before the dispatch that executes the
+# plan", "the spill queue drains before the checkpoint payload reads
+# the tail". Each edge below names one such contract as call-order
+# DOMINANCE inside one registered function: every call of `after`
+# must appear (in source order) after at least one call of `before`,
+# and BOTH must be present — so a refactor that deletes or reorders a
+# barrier turns the audit red instead of silently shipping a torn
+# journal or a stale tail. Names are frozen (tests and README refer
+# to them); edges may be added but never weakened in place.
+ORDERING_EDGES = {
+    # ISSUE 12 write-ahead contract: every sealed RoundPlan of a span
+    # is durable before the span's dispatch executes it (the journal
+    # is the authoritative decision log a takeover replays).
+    "wal-flush-before-dispatch": {
+        "path": "commefficient_tpu/federated/api.py",
+        "function": "dispatch_rounds",
+        "before": "_flush_write_ahead",
+        "after": "with_retries",
+        "why": "a plan executed before its journal line is durable "
+               "cannot be replayed by a coordinator takeover",
+    },
+    # ISSUE 11 mid-spill contract: the checkpoint payload reads the
+    # host tail only after every queued spill has committed to it.
+    "spill-drain-before-checkpoint-payload": {
+        "path": "commefficient_tpu/federated/statestore.py",
+        "function": "checkpoint_rows",
+        "before": "flush",
+        "after": "get_many",
+        "why": "a payload built from a tail with spills still in "
+               "flight loses evicted client rows (error-feedback "
+               "state) on resume",
+    },
+    # ISSUE 10 writer contract: the async checkpoint writer drains
+    # before any SYNCHRONOUS save so the manifest rotates in order.
+    "writer-drain-before-save-final": {
+        "path": "commefficient_tpu/training/cv_train.py",
+        "function": "main",
+        "before": "drain_persistence",
+        "after": "save_final",
+        "why": "a final save overtaking queued rotating saves rotates "
+               "the manifest out of order (resume picks a stale "
+               "newest)",
+    },
+    "writer-drain-before-save-final-gpt2": {
+        "path": "commefficient_tpu/training/gpt2_train.py",
+        "function": "main",
+        "before": "drain_persistence",
+        "after": "save_final",
+        "why": "same manifest-ordering contract as the CV driver",
+    },
+    # ISSUE 11 WAR hazard: the spill gather's device barrier must run
+    # before its rows are handed to the writer — the donating restore
+    # scatter that follows overwrites the gathered slots in place, a
+    # write jax does not order against the dependency-free gather.
+    "gather-barrier-before-donated-scatter": {
+        "path": "commefficient_tpu/federated/statestore.py",
+        "function": "_spill_chunk",
+        "before": "block_until_ready",
+        "after": "submit",
+        "why": "without the barrier the donated scatter's in-place "
+               "write races the spill gather's read of the same "
+               "buffer (observed as heap corruption / garbage rows)",
+    },
+}
+
+for _name, _edge in ORDERING_EDGES.items():
+    assert {"path", "function", "before", "after", "why"} <= set(_edge), (
+        f"ORDERING_EDGES[{_name!r}] is missing a required field")
+    assert _edge["before"] != _edge["after"], (
+        f"ORDERING_EDGES[{_name!r}]: before and after name the same "
+        "call — the edge is vacuous")

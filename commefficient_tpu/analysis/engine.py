@@ -146,6 +146,54 @@ def lint_paths(paths: Sequence[str],
 
 
 # ---------------------------------------------------------------------------
+# graph utilities shared by the concurrency tiers
+
+
+def find_cycles(graph: Dict[str, Sequence[str]]) -> List[List[str]]:
+    """Distinct cycles in a directed graph ({node: successors}),
+    each as [a, b, ..., a], deduped by node SET (one report per
+    lock-order cycle however many entry points reach it). Color-
+    marking DFS over sorted nodes, so the result is deterministic.
+    Shared by graftsync's static lock-order rule (SY002) and the
+    runtime LockOrderSanitizer — one cycle definition, two
+    enforcement points."""
+    cycles: List[List[str]] = []
+    seen: set = set()
+    state: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(v: str) -> None:
+        state[v] = 1
+        stack.append(v)
+        for w in sorted(graph.get(v, ())):
+            if state.get(w, 0) == 0:
+                dfs(w)
+            elif state.get(w) == 1:
+                cyc = stack[stack.index(w):] + [w]
+                canon = tuple(sorted(cyc[:-1]))
+                if canon not in seen:
+                    seen.add(canon)
+                    cycles.append(cyc)
+        stack.pop()
+        state[v] = 2
+
+    for v in sorted(graph):
+        if state.get(v, 0) == 0:
+            dfs(v)
+    return cycles
+
+
+def edges_to_graph(edges) -> Dict[str, List[str]]:
+    """(a, b) edge keys -> the {node: successors} map find_cycles
+    takes (isolated successors included so every node is a key)."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    return graph
+
+
+# ---------------------------------------------------------------------------
 # baseline
 
 

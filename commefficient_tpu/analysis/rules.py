@@ -856,6 +856,47 @@ def check_gl011(module: ModuleInfo) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GL012 — anonymous writer threads
+
+# graftscope (telemetry/trace) stitches writer spans into Perfetto
+# rows BY THREAD NAME, and the journal's trace records carry the
+# thread name as the correlation key. An anonymous thread gets the
+# interpreter's `Thread-N` counter name, which differs across
+# restarts (and between two writers started in a different order), so
+# a resumed run's spans land on a DIFFERENT Perfetto row than the
+# crashed run's — the cross-restart timeline graftscope exists for
+# silently splits. Mechanical and precise: every
+# `threading.Thread(...)` construction must pass an explicit `name=`
+# (the journal's "journal-writer", the checkpoint writer's
+# f"{name}-writer"); **kwargs forwarding is left alone (the name may
+# ride there).
+
+
+def check_gl012(module: ModuleInfo) -> Iterator[Violation]:
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func) not in ("threading.Thread", "Thread"):
+            continue
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **kwargs forwarding: can't see the name
+        if any(kw.arg == "name" for kw in node.keywords):
+            continue
+        if (len(node.args) >= 3
+                or any(isinstance(a, ast.Starred) for a in node.args)):
+            continue  # Thread(group, target, name, ...): the third
+            # positional slot IS the name (or *args may cover it)
+        yield Violation(
+            module.path, node.lineno, node.col_offset, "GL012",
+            "`threading.Thread(...)` without an explicit `name=`: the "
+            "interpreter's Thread-N fallback differs across restarts, "
+            "so graftscope's thread-keyed trace rows (and the "
+            "watchdog's writer-naming) break across a resume; name "
+            "the thread after its role (journal-writer, "
+            "state-spill-writer)")
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "GL001": check_gl001,
@@ -869,6 +910,7 @@ ALL_RULES = {
     "GL009": check_gl009,
     "GL010": check_gl010,
     "GL011": check_gl011,
+    "GL012": check_gl012,
 }
 
 RULE_DOCS = {
@@ -897,4 +939,7 @@ RULE_DOCS = {
     "GL011": "wall-clock delta (time.time() difference) used as a "
              "duration — NTP steps corrupt it; use "
              "time.monotonic()/perf_counter for intervals",
+    "GL012": "threading.Thread constructed without an explicit name= "
+             "(anonymous Thread-N names break graftscope's "
+             "thread-keyed trace rows across restarts)",
 }

@@ -1,9 +1,9 @@
-"""Runtime sanitizers: the dynamic half of graftlint.
+"""Runtime sanitizers: the dynamic half of graftlint and graftsync.
 
-The static pass (engine/rules) catches what syntax can prove; these
-context managers catch what only execution can — armed by the test
-suite so the round engine's two load-bearing runtime contracts are
-EXECUTED checks, not prose:
+The static passes (engine/rules, syncaudit) catch what syntax can
+prove; these catch what only execution can — armed by the test suite
+so the engine's load-bearing runtime contracts are EXECUTED checks,
+not prose:
 
   * `assert_program_count(n)` — a compilation counter around a block.
     ROADMAP's "exactly three traced round programs" (mask-free,
@@ -24,12 +24,39 @@ EXECUTED checks, not prose:
     / gather_host) are deliberately explicit so a guarded round is
     provably sync-free everywhere else.
 
-The `sanitize` pytest fixture (tests/conftest.py) hands tests a
-`Sanitizer` exposing both.
+  * `LockOrderSanitizer` — graftsync's runtime twin (ISSUE 14).
+    Installed, it replaces `threading.Lock`/`threading.RLock` with
+    recording proxies: every successful acquisition while other
+    instrumented locks are held adds a lock-order edge, and
+    `assert_acyclic()` at teardown raises `LockOrderError` naming
+    the cycle when two threads ever took instrumented locks in
+    opposite orders — the dynamic ABBA check over orders the static
+    SY002 graph cannot see (locks reached through aliases, orders
+    composed across modules at runtime). Instrumentation is by
+    OBJECT, so the RLock re-entrancy idiom adds no self-edges, and
+    `queue.Queue`'s internal mutex/conditions are instrumented for
+    free (queue looks `threading.Lock` up dynamically).
+  * `interleaving_stress()` — deterministic delay injection at the
+    writer-queue handoffs (`queue.Queue.put`/`get`): a counter-driven
+    (never random — replayable) sub-millisecond stagger that widens
+    the producer/drain race windows the bounded-queue writers must
+    tolerate. tier1.sh arms both over the pipeline/statetier/
+    controlplane suites via the `CCTPU_SYNC_SANITIZE=1` autouse
+    fixture (tests/conftest.py).
+
+The `sanitize` pytest fixture (tests/conftest.py) hands tests the
+program-count/transfer pair; `lock_sanitizer` hands them an
+installed LockOrderSanitizer.
 """
 from __future__ import annotations
 
 import contextlib
+import itertools
+import queue as _queue
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
 
 import jax
 
@@ -157,3 +184,212 @@ class Sanitizer:
     count_programs = staticmethod(count_programs)
     assert_program_count = staticmethod(assert_program_count)
     forbid_transfers = staticmethod(forbid_transfers)
+
+
+# ---------------------------------------------------------------------------
+# LockOrderSanitizer — graftsync's runtime twin (ISSUE 14)
+
+
+class LockOrderError(AssertionError):
+    """The observed lock-acquisition graph contains a cycle: two
+    threads took instrumented locks in opposite orders at least once
+    — a latent ABBA deadlock that only needs worse timing."""
+
+
+class _SanitizedLock:
+    """Proxy around a real Lock/RLock that reports acquisitions to
+    its owning sanitizer. Unknown attributes (RLock's
+    `_release_save`/`_acquire_restore`/`_is_owned`, used by
+    Condition) delegate to the wrapped lock — Condition then drives
+    the REAL lock for its wait dance, which keeps the proxy's held
+    bookkeeping aligned with the logical critical section."""
+
+    def __init__(self, san: "LockOrderSanitizer", inner, node: str):
+        self._san = san
+        self._inner = inner
+        self._node = node
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._san._note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._san._note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class LockOrderSanitizer:
+    """Record per-thread lock-acquisition edges; assert the global
+    graph acyclic at teardown.
+
+    `install()` swaps `threading.Lock`/`threading.RLock` for proxy
+    factories (locks created BEFORE install stay uninstrumented —
+    the fixture installs before constructing the objects under
+    test); `uninstall()` restores the factories and freezes edge
+    recording (already-created proxies keep working, they just stop
+    reporting). Nodes are per lock OBJECT — `file:line#serial` of
+    the creation site — so two queues' mutexes never alias into one
+    node (the false-positive class a lockdep-style per-class graph
+    would hit), and an RLock re-acquisition adds no self-edge.
+    Deterministic given a deterministic schedule: edges carry the
+    acquiring thread and site for the report, not timestamps."""
+
+    def __init__(self):
+        # real (uninstrumented) lock: the sanitizer must never
+        # instrument its own bookkeeping
+        self._graph_lock = threading.Lock()
+        # (outer node, inner node) -> (thread name, "file:line" of
+        # the inner acquisition)
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._held = threading.local()
+        self._serial = itertools.count()
+        self._active = False
+        self._orig: Optional[tuple] = None
+
+    # ---------------- factory patching --------------------------------
+    @staticmethod
+    def _site(depth: int = 2) -> str:
+        frame = sys._getframe(depth)
+        # walk out of this module so the node names the USER's
+        # creation/acquisition site, not the proxy internals
+        while frame is not None and frame.f_globals.get(
+                "__name__") == __name__:
+            frame = frame.f_back
+        if frame is None:
+            return "<unknown>"
+        return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+    def _make(self, ctor):
+        def factory():
+            node = f"{self._site()}#{next(self._serial)}"
+            return _SanitizedLock(self, ctor(), node)
+        return factory
+
+    def install(self) -> None:
+        if self._orig is not None:
+            return
+        self._orig = (threading.Lock, threading.RLock)
+        threading.Lock = self._make(self._orig[0])
+        threading.RLock = self._make(self._orig[1])
+        self._active = True
+
+    def uninstall(self) -> None:
+        if self._orig is None:
+            return
+        threading.Lock, threading.RLock = self._orig
+        self._orig = None
+        self._active = False
+
+    def __enter__(self):
+        self.install()
+        return self
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # ---------------- recording ---------------------------------------
+    def _stack(self) -> List[_SanitizedLock]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _note_acquire(self, lock: _SanitizedLock) -> None:
+        stack = self._stack()
+        if self._active:
+            for held in stack:
+                if held is lock:
+                    continue  # RLock re-entrancy: no self-edge
+                key = (held._node, lock._node)
+                if key not in self._edges:
+                    with self._graph_lock:
+                        self._edges.setdefault(
+                            key, (threading.current_thread().name,
+                                  self._site(3)))
+        stack.append(lock)
+
+    def _note_release(self, lock: _SanitizedLock) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    # ---------------- verdict -----------------------------------------
+    def edges(self) -> Dict[Tuple[str, str], Tuple[str, str]]:
+        with self._graph_lock:
+            return dict(self._edges)
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """One cycle in the observed acquisition graph, or None —
+        the same cycle definition the static SY002 rule uses
+        (engine.find_cycles)."""
+        from commefficient_tpu.analysis.engine import (
+            edges_to_graph, find_cycles,
+        )
+        cycles = find_cycles(edges_to_graph(self.edges()))
+        return cycles[0] if cycles else None
+
+    def assert_acyclic(self) -> None:
+        cyc = self.find_cycle()
+        if cyc is None:
+            return
+        edges = self.edges()
+        sites = []
+        for a, b in zip(cyc, cyc[1:]):
+            thread, site = edges[(a, b)]
+            sites.append(f"  {a} -> {b}  (thread {thread!r} at {site})")
+        raise LockOrderError(
+            "lock-order cycle observed — two threads acquired these "
+            "locks in opposite orders at least once (ABBA deadlock "
+            "given worse timing):\n" + "\n".join(sites)
+            + "\npick ONE global acquisition order (graftsync SY002 "
+            "checks the static `with` nesting; this caught an order "
+            "composed at runtime)")
+
+
+@contextlib.contextmanager
+def interleaving_stress(delay: float = 0.0005, period: int = 3):
+    """Deterministically stagger writer-queue handoffs: every
+    `queue.Queue.put`/`get` sleeps `(i % period) * delay` first, `i`
+    a shared counter — so producer/drain interleavings that need an
+    unlucky scheduler to collide are collided ON PURPOSE, every run,
+    with no randomness (a failure under stress replays). The delays
+    are host-side only and orders of magnitude below the drain
+    timeouts, so semantics (FIFO order, bounded back-pressure, drain
+    completeness) are untouched — only the timing is hostile."""
+    counter = itertools.count()
+    orig_put, orig_get = _queue.Queue.put, _queue.Queue.get
+
+    def put(self, *args, **kwargs):
+        time.sleep((next(counter) % period) * delay)
+        return orig_put(self, *args, **kwargs)
+
+    def get(self, *args, **kwargs):
+        time.sleep((next(counter) % period) * delay)
+        return orig_get(self, *args, **kwargs)
+
+    _queue.Queue.put = put
+    _queue.Queue.get = get
+    try:
+        yield
+    finally:
+        _queue.Queue.put = orig_put
+        _queue.Queue.get = orig_get

@@ -528,18 +528,25 @@ class TieredStateStore:
                 in_tail = ent is None and self._tail.has(cid)
             if ent is not None:
                 completers, i = ent
-                self._warm[cid] = {
-                    f: np.array(np.asarray(completers[f]())[i])
-                    for f in self.fields}
+                # materialize the in-flight spill OUTSIDE the lock
+                # (the completer blocks on the device->host copy —
+                # the SY004 hostage class); only the cache insert
+                # needs the guard
+                rows = {f: np.array(np.asarray(completers[f]())[i])
+                        for f in self.fields}
+                with self._lock:
+                    self._warm[cid] = rows
             elif in_tail:
                 with self._lock:
                     self._warm[cid] = self._tail.get(cid)
             # never-seen clients restore from init — nothing to warm
         # the cache is consumed by _rows_for and bounded: drop entries
-        # once it exceeds a few cohorts' worth
-        if len(self._warm) > 4 * max(self.cfg.num_workers, 1):
-            for cid in list(self._warm)[:len(self._warm) // 2]:
-                del self._warm[cid]
+        # once it exceeds a few cohorts' worth (under the guard — the
+        # commit thread's _rows_for reads _warm concurrently)
+        with self._lock:
+            if len(self._warm) > 4 * max(self.cfg.num_workers, 1):
+                for cid in list(self._warm)[:len(self._warm) // 2]:
+                    del self._warm[cid]
 
     # ---------------- telemetry ------------------------------------------
     def take_journal_fields(self) -> dict:

@@ -80,6 +80,12 @@ consumers must tolerate kinds they don't know):
                           per-program `programs` {ici_bytes,
                           dcn_bytes, dcn_collectives}, the `meshes`
                           link models, geometry, finding count
+  sync_audit_digest       graftsync's concurrency-audit report
+                          (analysis/syncaudit): 64-hex sha256
+                          `digest` (bit-identical across runs),
+                          per-rule `rules` counts, the `registry`
+                          sizes (shared-state guards / ordering
+                          edges), and the finding count
 """
 from __future__ import annotations
 
@@ -431,7 +437,12 @@ def validate_journal(path: str,
       * `mesh_audit_digest` events (graftmesh per-link reports) carry
         the same digest/programs shape with non-negative numeric
         ici_bytes/dcn_bytes/dcn_collectives per program — the record
-        the million-client refactor's before/after comm table reads.
+        the million-client refactor's before/after comm table reads;
+      * `sync_audit_digest` events (graftsync concurrency reports,
+        analysis/syncaudit) carry a 64-hex string `digest`, a `rules`
+        object mapping each SY rule to a non-negative integer count,
+        and a non-negative integer `findings` — the record tier1's
+        sync step journals, so its shape must not rot.
 
     A `run_start` event opens a new run SEGMENT and resets the round
     tracking: a preempted run resumed with the same --journal_path
@@ -570,6 +581,35 @@ def validate_journal(path: str,
                                 f"record {n}: {ev} program "
                                 f"{prog!r} `{field}` must be a "
                                 f"non-negative number (got {v2!r})")
+        if rec.get("event") == "sync_audit_digest":
+            # graftsync (analysis/syncaudit): the digest is pinned to
+            # 64-hex — the bit-identical-across-runs claim is checked
+            # on exactly this value, so a truncated or non-canonical
+            # digest is a schema rot, not a style choice
+            d = rec.get("digest")
+            if not (isinstance(d, str) and len(d) == 64
+                    and all(c in "0123456789abcdef" for c in d)):
+                problems.append(
+                    f"record {n}: sync_audit_digest `digest` must be "
+                    f"a 64-char lowercase hex string (got {d!r})")
+            rls = rec.get("rules")
+            if not isinstance(rls, dict):
+                problems.append(
+                    f"record {n}: sync_audit_digest `rules` is not "
+                    "an object")
+            else:
+                for rule, cnt in sorted(rls.items()):
+                    if not (isinstance(cnt, int) and cnt >= 0):
+                        problems.append(
+                            f"record {n}: sync_audit_digest rule "
+                            f"{rule!r} count must be a non-negative "
+                            f"integer (got {cnt!r})")
+            fnd = rec.get("findings")
+            if fnd is not None and not (isinstance(fnd, int)
+                                        and fnd >= 0):
+                problems.append(
+                    f"record {n}: sync_audit_digest `findings` must "
+                    f"be a non-negative integer (got {fnd!r})")
         if rec.get("event") == "run_end":
             total_down = _comm_field(rec, n, "down_bytes_total")
             total_up = _comm_field(rec, n, "up_bytes_total")

@@ -120,7 +120,13 @@ class AsyncCheckpointWriter:
                  drain_timeout: float = 0.0,
                  name: str = "checkpoint"):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(max_pending, 1))
+        # deferred writer failure: stored on the writer thread,
+        # consumed (cleared) on the caller's thread at drain/submit —
+        # cross-thread state, guarded (graftsync SY001,
+        # analysis/domains.SHARED_STATE) so a failure landing while
+        # the caller swaps the slot is never lost
         self._exc: Optional[BaseException] = None
+        self._exc_lock = threading.Lock()
         self._closed = False
         # writer-thread watchdog (ISSUE 12 satellite): drain()/close()
         # deadline in seconds (0 = wait forever); `name` labels the
@@ -157,14 +163,16 @@ class AsyncCheckpointWriter:
                     else:
                         job()
                 except BaseException as e:  # graftlint: disable=GL005 -- not swallowed: deferred re-raise on the caller's thread at drain()/submit() (_raise_pending); jobs are write closures, never fault-harness code
-                    if self._exc is None:
-                        self._exc = e
+                    with self._exc_lock:
+                        if self._exc is None:
+                            self._exc = e
             finally:
                 self._q.task_done()
 
     def _raise_pending(self) -> None:
-        if self._exc is not None:
+        with self._exc_lock:
             exc, self._exc = self._exc, None
+        if exc is not None:
             raise exc
 
     def submit(self, job: Callable[[], None]) -> None:

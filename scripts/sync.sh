@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# graftsync: the repo's static concurrency & durability-ordering audit
+# (rules SY001-SY006, see README "Concurrency auditing"). Runs from
+# any cwd; extra args pass through (e.g. `bash scripts/sync.sh
+# --list-rules`, `--no-baseline`, `--write-baseline`, `--report`).
+#
+# Like graftlint this pass is pure-AST and jax-free: it parses the
+# five host packages (telemetry/, utils/, federated/, parallel/,
+# training/) and checks the shared-state guard registry, the static
+# lock-order graph, queue-ownership transfer, blocking calls under
+# held locks, thread lifecycle, and the named happens-before edges of
+# analysis/domains.ORDERING_EDGES — no accelerator, no device state.
+#
+# Exit codes (the graftaudit/graftmesh contract): 0 clean, 1 rule
+# violations, 2 baseline drift only (regenerate with --write-baseline
+# and commit the diff). The shipped baseline is EMPTY.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m commefficient_tpu.analysis.syncaudit "$@"

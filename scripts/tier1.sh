@@ -37,6 +37,20 @@ timeout -k 10 300 env \
     || { echo "GRAFTMESH_FAILED"; exit 1; }
 python scripts/journal_summary.py "$MJR" \
     || { echo "MESH_JOURNAL_INVALID"; exit 1; }
+# concurrency audit fourth (ISSUE 14): graftsync — pure-AST over the
+# host control plane's five packages, checking the shared-state guard
+# registry, the static lock-order graph, queue-ownership transfer,
+# blocking-under-lock, thread lifecycle, and the durability-ordering
+# edges (rules SY001-SY006; empty exact-match baseline). Exit 1 =
+# contract violation, 2 = baseline drift; either fails tier-1. Its
+# sync_audit_digest is journaled and the journal must validate, so
+# the digest record format is exercised every CI run.
+SYJR=/tmp/_t1_syncaudit.jsonl
+rm -f "$SYJR"
+timeout -k 10 120 bash "$(dirname "$0")/sync.sh" --journal "$SYJR" \
+    || { echo "GRAFTSYNC_FAILED"; exit 1; }
+python scripts/journal_summary.py "$SYJR" \
+    || { echo "SYNC_JOURNAL_INVALID"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -50,6 +64,23 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 # journal it wrote — malformed or duplicate-round events fail tier-1.
 # Only runs when the pytest gate above already passed.
 if [ "$rc" -eq 0 ]; then
+  # lock-order-sanitized concurrency suites (ISSUE 14): the pipeline /
+  # statetier / controlplane markers — the writer-thread-richest
+  # suites in the tree — re-run with graftsync's runtime twin armed
+  # (CCTPU_SYNC_SANITIZE=1, tests/conftest.py): threading.Lock/RLock
+  # are swapped for recording proxies, the observed acquisition graph
+  # must stay acyclic per test, and queue handoffs get deterministic
+  # interleaving delays that widen producer/drain race windows. A
+  # lock-order cycle or a stress-exposed writer race fails tier-1.
+  rm -f /tmp/_t1_sync.log
+  timeout -k 10 600 env JAX_PLATFORMS=cpu CCTPU_SYNC_SANITIZE=1 \
+      python -m pytest tests/ -q \
+      -m 'pipeline or statetier or controlplane' \
+      -p no:cacheprovider -p no:xdist -p no:randomly \
+      > /tmp/_t1_sync.log 2>&1 \
+      || { echo "SYNC_SANITIZED_SUITES_FAILED"; \
+           tail -60 /tmp/_t1_sync.log; exit 1; }
+
   JR=/tmp/_t1_journal.jsonl
   rm -f "$JR"
   timeout -k 10 300 env JAX_PLATFORMS=cpu \

@@ -52,6 +52,50 @@ def sanitize():
 
 
 @pytest.fixture
+def lock_sanitizer():
+    """An installed LockOrderSanitizer (analysis/runtime): locks the
+    test constructs are instrumented, and the acquisition graph is
+    asserted acyclic at teardown — the runtime ABBA check behind
+    graftsync SY002. Construct the objects under test INSIDE the
+    test (locks created before install are invisible)."""
+    from commefficient_tpu.analysis.runtime import LockOrderSanitizer
+
+    san = LockOrderSanitizer()
+    san.install()
+    try:
+        yield san
+    finally:
+        san.uninstall()
+    san.assert_acyclic()
+
+
+@pytest.fixture(autouse=True)
+def _sync_sanitize():
+    """CCTPU_SYNC_SANITIZE=1 (scripts/tier1.sh arms this over the
+    pipeline/statetier/controlplane suites) runs EVERY test under the
+    LockOrderSanitizer plus deterministic queue-handoff delay
+    injection (analysis/runtime.interleaving_stress), and asserts the
+    observed lock graph acyclic at teardown. Off by default: the
+    factory patching is global state no unrelated unit test should
+    depend on."""
+    if not os.environ.get("CCTPU_SYNC_SANITIZE"):
+        yield
+        return
+    from commefficient_tpu.analysis.runtime import (
+        LockOrderSanitizer, interleaving_stress,
+    )
+
+    san = LockOrderSanitizer()
+    san.install()
+    try:
+        with interleaving_stress():
+            yield
+    finally:
+        san.uninstall()
+    san.assert_acyclic()
+
+
+@pytest.fixture
 def ckpt_dir(tmp_path):
     """Isolated checkpoint directory per test: checkpoint/rotation
     tests never see each other's manifests or stamped files."""

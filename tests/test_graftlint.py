@@ -288,6 +288,37 @@ GL011_NEG = """
         return time.time()
 """
 
+GL012_POS = """
+    import threading
+
+    class Writer:
+        def start(self):
+            # anonymous: Perfetto rows keyed by Thread-N break across
+            # restarts
+            self._thread = threading.Thread(target=self._run,
+                                            daemon=True)
+            self._thread.start()
+"""
+GL012_NEG = """
+    import threading
+
+    class Writer:
+        def start(self, **extra):
+            self._thread = threading.Thread(target=self._run,
+                                            name="journal-writer",
+                                            daemon=True)
+            self._thread.start()
+
+        def start_forwarded(self, kwargs):
+            # **kwargs forwarding: the name may ride there
+            return threading.Thread(target=self._run, **kwargs)
+
+        def start_positional(self):
+            # Thread(group, target, name): the third positional slot
+            # IS the name
+            return threading.Thread(None, self._run, "journal-writer")
+"""
+
 # rule -> (positive, negative[, lint path]); GL010 is path-scoped to
 # the packages that construct shardings, so its fixtures lint under a
 # parallel/ path (everything else uses the default snippet.py)
@@ -304,6 +335,7 @@ FIXTURES = {
     "GL010": (GL010_POS, GL010_NEG,
               "commefficient_tpu/parallel/snippet.py"),
     "GL011": (GL011_POS, GL011_NEG),
+    "GL012": (GL012_POS, GL012_NEG),
 }
 
 
