@@ -408,6 +408,16 @@ def audit_configs(backends: Sequence[str] = ("xla", "pallas"),
         do_topk_down=True, k=g["k"], down_k=32, state_tier="host",
         state_working_set=TIER_WORKING_SET,
         **base).validate()))
+    # value-fault screening (ISSUE 16): a sketch config with the norm
+    # screen on traces the SCREENED program family — the only family
+    # with the poison mask + screen-scalar operands — so the admission
+    # arithmetic (finite mask, all_gather'd cohort median, survivor
+    # fold) is priced and contract-checked like every other program.
+    out.append(("sketch-screened", Config(
+        mode="sketch", error_type="virtual", virtual_momentum=0.9,
+        local_momentum=0.0, k=g["k"], num_rows=g["rows"],
+        num_cols=g["cols"], num_blocks=1, kernel_backend="xla",
+        update_screen="norm", **base).validate()))
     return out
 
 
@@ -453,7 +463,7 @@ def build_workload(cfg):
         (jnp.zeros((g["W"], g["B"], g["D"]), jnp.float32),
          jnp.zeros((g["W"], g["B"]), jnp.float32)),
         jnp.ones((g["W"], g["B"]), jnp.float32))
-    variants = audit_batch_variants(batch)
+    variants = audit_batch_variants(batch, cfg)
     lr = jnp.float32(0.1)
     key = jax.random.PRNGKey(0)
     return handle, server, clients, variants, lr, key
@@ -664,7 +674,7 @@ def run_audit(backends: Sequence[str] = ("xla", "pallas"),
     ([tool.graftaudit] population_inventory_configs). The gather/
     scatter state-motion programs always run in inventory mode: their
     inventory is the named client-state map."""
-    from commefficient_tpu.federated.round import PROGRAM_VARIANTS
+    from commefficient_tpu.federated.round import program_variants_for
 
     programs: Dict[str, dict] = {}
     findings: List[AuditFinding] = []
@@ -672,7 +682,10 @@ def run_audit(backends: Sequence[str] = ("xla", "pallas"),
         strict = cfg_name not in set(inventory_configs)
         handle, server, clients, variants, lr, key = build_workload(cfg)
         findings.extend(donation_findings(cfg_name, handle))
-        for variant in PROGRAM_VARIANTS:
+        # per-config program set: default configs trace the three
+        # default variants; screened configs (ISSUE 16) trace the two
+        # screened ones instead
+        for variant in program_variants_for(cfg):
             prog = f"{cfg_name}/{variant}"
             closed, in_names, out_names = trace_variant(
                 handle, server, clients, variants[variant], lr, key)
@@ -686,9 +699,14 @@ def run_audit(backends: Sequence[str] = ("xla", "pallas"),
                 "cost": jaxpr_cost(closed).as_dict(),
                 "population_inventory": inventory,
             }
+        # state motion is variant-independent (gather/scatter only see
+        # client_ids) — trace it from whichever variant the config's
+        # family provides
+        motion_batch = variants.get("mask_free",
+                                    variants.get("screened"))
         for motion, (closed, in_names, out_names) in \
                 trace_state_motion(handle, clients,
-                                   variants["mask_free"]).items():
+                                   motion_batch).items():
             prog = f"{cfg_name}/{motion}"
             findings.extend(
                 forbidden_primitive_findings(prog, closed))

@@ -38,6 +38,7 @@ DOMAINS = {
     "dropout": 0x0D120,    # utils/faults.bernoulli_survivors
     "straggler": 0x51044,  # utils/faults.straggler_work_fractions
     "sampler": 0x5C4ED,    # scheduler/policy.ThroughputAwareSampler
+    "poison": 0xBAD0D,     # utils/faults.poison_mask (value faults)
 }
 
 _values = list(DOMAINS.values())
@@ -111,6 +112,12 @@ SHARED_STATE = {
     "TieredStateStore._tail": "_lock",
     "TieredStateStore._pending": "_lock",
     "TieredStateStore._warm": "_lock",
+    # ISSUE 16 checksummed tiers: per-row CRCs are recorded by the
+    # spill writer's commit and read/invalidated by the restore path's
+    # verification; quarantine events are appended at verification
+    # time and drained by the telemetry emitter
+    "TieredStateStore._sums": "_lock",
+    "TieredStateStore._quarantined": "_lock",
     # utils/checkpoint.py — the deferred writer failure is stored on
     # the writer thread and consumed (cleared) on the caller's thread
     "AsyncCheckpointWriter._exc": "_exc_lock",
@@ -174,6 +181,21 @@ ORDERING_EDGES = {
         "before": "drain_persistence",
         "after": "save_final",
         "why": "same manifest-ordering contract as the CV driver",
+    },
+    # ISSUE 16 integrity contract: every host tail row is checksum-
+    # verified (and, on mismatch, quarantined back to its init value)
+    # BEFORE the restore scatter installs it in a device slot — the
+    # verified read happens inside _rows_for, so the scatter dispatch
+    # must dominate it in source order. A reorder here would feed a
+    # bit-rotted memmap row straight into the next round's gather.
+    "checksum-verify-before-restore": {
+        "path": "commefficient_tpu/federated/statestore.py",
+        "function": "_restore_chunk",
+        "before": "_rows_for",
+        "after": "scatter",
+        "why": "a restore that scatters tail rows before their "
+               "checksum verification installs silently corrupted "
+               "error-feedback state on the device",
     },
     # ISSUE 11 WAR hazard: the spill gather's device barrier must run
     # before its rows are handed to the writer — the donating restore

@@ -117,6 +117,17 @@ SPAN_LEN = 2
 MESH_PROGRAMS = ("mask_free", "dropout", "dropout_stragglers",
                  "gather", "scatter", "span")
 
+
+def mesh_programs_for(cfg) -> tuple:
+    """Per-config mesh program list: the config's steady-state round
+    variants (federated/round.program_variants_for — the screened
+    family for ISSUE 16 value-fault configs, the three defaults
+    otherwise) plus the family-independent state-motion pair and the
+    scanned span."""
+    from commefficient_tpu.federated.round import program_variants_for
+    return tuple(program_variants_for(cfg)) + ("gather", "scatter",
+                                               "span")
+
 # jaxpr equations that re-lay-out an existing value (AU011's
 # reshard-class set)
 _RESHARD_PRIMITIVES = frozenset({"sharding_constraint", "device_put"})
@@ -262,11 +273,27 @@ def build_mesh_workload(cfg, mesh):
     ones = mh.globalize(mesh, P(), np.ones(g["W"], np.float32))
     half = mh.globalize(mesh, P(),
                         np.full(g["W"], 0.5, np.float32))
-    variants = {
-        "mask_free": batch._replace(survivors=None, work=None),
-        "dropout": batch._replace(survivors=ones, work=None),
-        "dropout_stragglers": batch._replace(survivors=ones, work=half),
-    }
+    from commefficient_tpu.federated.round import screened_family
+    if screened_family(cfg):
+        # screened family (ISSUE 16): the poison mask and the traced
+        # screen-enable scalar are placed exactly the way the dispatch
+        # path places them (globalize, replicated) — host-default
+        # operands here would rightly fire AU009
+        zeros = mh.globalize(mesh, P(), np.zeros(g["W"], np.float32))
+        s_on = mh.globalize(mesh, P(), np.float32(1.0))
+        variants = {
+            "screened": batch._replace(
+                survivors=ones, work=None, poison=zeros, screen=s_on),
+            "screened_stragglers": batch._replace(
+                survivors=ones, work=half, poison=zeros, screen=s_on),
+        }
+    else:
+        variants = {
+            "mask_free": batch._replace(survivors=None, work=None),
+            "dropout": batch._replace(survivors=ones, work=None),
+            "dropout_stragglers": batch._replace(survivors=ones,
+                                                 work=half),
+        }
     # the CONCRETE gathered cohort: executed through the production
     # jitted gather (explicit out_shardings), so the round variants'
     # cohort operands carry exactly the placement the dispatch path
@@ -282,6 +309,16 @@ def build_mesh_workload(cfg, mesh):
                                       np.float32), leading_axes=1)),
         mh.shard_rows(mesh, np.ones((SPAN_LEN, g["W"], g["B"]),
                                     np.float32), leading_axes=1))
+    if screened_family(cfg):
+        # the screened span scans the screened treedef: per-round
+        # survivor/poison rows plus the per-round screen scalar lane
+        span = span._replace(
+            survivors=mh.globalize(mesh, P(), np.ones(
+                (SPAN_LEN, g["W"]), np.float32)),
+            poison=mh.globalize(mesh, P(), np.zeros(
+                (SPAN_LEN, g["W"]), np.float32)),
+            screen=mh.globalize(mesh, P(), np.ones(
+                (SPAN_LEN,), np.float32)))
     lrs = mh.globalize(mesh, P(), np.full((SPAN_LEN,), 0.1, np.float32))
     lr = mh.globalize(mesh, P(), np.float32(0.1))
     key = mh.globalize(mesh, P(),
@@ -309,13 +346,14 @@ def trace_mesh_program(handle, server, clients, cohort, variants,
                  + _leaf_names("batch", span)
                  + _leaf_names("lr", lrs) + _leaf_names("key", key))
     elif program == "gather":
-        ids = variants["mask_free"].client_ids
+        # client_ids are identical across variants — take any
+        ids = next(iter(variants.values())).client_ids
         args = (clients, ids)
         closed = jax.make_jaxpr(handle.gather_fn)(*args)
         names = (_leaf_names("clients", clients)
                  + _leaf_names("ids", ids))
     elif program == "scatter":
-        ids = variants["mask_free"].client_ids
+        ids = next(iter(variants.values())).client_ids
         args = (clients, ids, cohort)
         closed = jax.make_jaxpr(handle.scatter_fn)(*args)
         names = (_leaf_names("clients", clients)
@@ -518,15 +556,16 @@ def run_mesh_audit(backends: Sequence[str] = ("xla", "pallas"),
         # single-device reshard baseline, shared across meshes: the
         # same program traced on the 1-device mesh (AU011's "the
         # single-device program doesn't have" reference)
+        cfg_programs = mesh_programs_for(cfg)
         single = build_mesh_workload(cfg, make_client_mesh(1))
         single_counts = {}
-        for program in MESH_PROGRAMS:
+        for program in cfg_programs:
             closed_1, _ = trace_mesh_program(*single, program)
             single_counts[program] = len(_reshard_eqns(closed_1))
         for mesh_name, entry in meshes.items():
             mesh, link = entry["mesh"], entry["link"]
             workload = build_mesh_workload(cfg, mesh)
-            for program in MESH_PROGRAMS:
+            for program in cfg_programs:
                 prog = f"{cfg_name}/{program}@{mesh_name}"
                 closed, inputs = trace_mesh_program(*workload, program)
                 cost = collective_cost(closed, link)

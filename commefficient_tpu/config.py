@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
 ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
+SCREEN_MODES = ("off", "finite", "norm")
+POISON_KINDS = ("nan", "inf", "scale")
 
 # dataset -> num_classes (reference: utils.py:37-44); PERSONA is a
 # language-modeling dataset so has no class count.
@@ -210,6 +212,39 @@ class Config:
     straggler_rate: float = 0.0
     straggler_min_work: float = 0.1
     straggler_cutoff: float = 0.0
+    # numeric-integrity layer (ISSUE 16, federated/round.py screened
+    # programs). update_screen is the in-round update ADMISSION policy:
+    # "off" — the default, bit-identical to a build without the
+    # feature (default configs trace the original three round
+    # programs) — "finite" screens any client whose local update
+    # carries a NaN/Inf, "norm" additionally screens norm outliers
+    # (update l2 > screen_norm_mult x the cohort's median l2 over
+    # surviving, measurable clients; rounds with no measurable
+    # survivor admit everyone, so the screen is zero-survivor-safe).
+    # A screened client takes EXACTLY the dropped-client path — state
+    # rows bit-untouched, survivor-count reweighting, survivor-only
+    # accounting — so screening composes with dropout, stragglers,
+    # deadlines, and async admission for free.
+    update_screen: str = "off"
+    screen_norm_mult: float = 5.0
+    # value-fault INJECTION (utils/faults.poison_mask): each sampled
+    # client's update is corrupted with this per-round probability —
+    # deterministic in (seed, round) on its own PRNG domain, same
+    # replay contract as client_dropout. poison_kind picks the
+    # corruption: nan / inf overwrite the transmitted update, scale
+    # multiplies it by 2^40 (a finite explosion only the norm screen
+    # catches). 0.0 keeps every default program untouched.
+    poison_rate: float = 0.0
+    poison_kind: str = "nan"
+    # finite-frontier auto-rollback (the drivers' numeric_trip
+    # handler): after a non-finite update/error-l2 trips telemetry and
+    # the run rolls back to the newest finite checkpoint, screening is
+    # FORCE-ENABLED for this many rounds so the replayed fault is
+    # admitted out instead of re-tripping; bounded by
+    # max_numeric_rollbacks trips per run, after which the driver
+    # fails loud instead of thrashing.
+    rollback_screen_rounds: int = 8
+    max_numeric_rollbacks: int = 2
     # keep the newest k rotated mid-run checkpoints (utils/checkpoint.
     # save_rotating); older ones are pruned after each atomic save
     keep_checkpoints: int = 3
@@ -581,6 +616,35 @@ class Config:
             raise ValueError(
                 f"straggler_cutoff={self.straggler_cutoff} must be in "
                 "[0, 1] (fractions below it degrade to dropout)")
+        if self.update_screen not in SCREEN_MODES:
+            raise ValueError(
+                f"unknown update_screen {self.update_screen!r} "
+                "(choices: off, finite, norm — federated/round.py "
+                "screened programs)")
+        if self.screen_norm_mult <= 1.0:
+            raise ValueError(
+                f"screen_norm_mult={self.screen_norm_mult} must be "
+                "> 1 (an update AT the cohort median is by definition "
+                "not an outlier; <= 1 would screen half the cohort "
+                "every round)")
+        if not 0.0 <= self.poison_rate < 1.0:
+            raise ValueError(
+                f"poison_rate={self.poison_rate} must be in [0, 1) "
+                "(1.0 would corrupt every client every round — no "
+                "finite update would ever survive the screen)")
+        if self.poison_kind not in POISON_KINDS:
+            raise ValueError(
+                f"unknown poison_kind {self.poison_kind!r} "
+                "(choices: nan, inf, scale — utils/faults)")
+        if self.rollback_screen_rounds < 1:
+            raise ValueError(
+                "rollback_screen_rounds must be >= 1: a rollback that "
+                "resumes with zero forced-screen rounds replays the "
+                "same non-finite update and trips forever")
+        if self.max_numeric_rollbacks < 0:
+            raise ValueError(
+                "max_numeric_rollbacks must be >= 0 (0 = a numeric "
+                "trip fails loud immediately, no rollback)")
         if self.keep_checkpoints < 1:
             raise ValueError("keep_checkpoints must be >= 1")
         if self.ckpt_max_age_hours < 0:
@@ -904,6 +968,39 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                    help="work fractions below this degrade to client "
                         "dropout: no upload, state bit-untouched "
                         "(Config.straggler_cutoff)")
+    p.add_argument("--update_screen", choices=list(SCREEN_MODES),
+                   default="off",
+                   help="in-round update admission (ISSUE 16, "
+                        "federated/round.py): finite screens NaN/Inf "
+                        "client updates, norm additionally screens "
+                        "cohort-median norm outliers; a screened "
+                        "client takes exactly the dropped-client path "
+                        "(off = default, bit-identical programs)")
+    p.add_argument("--screen_norm_mult", type=float, default=5.0,
+                   help="norm-screen outlier threshold: screen a "
+                        "client whose update l2 exceeds this multiple "
+                        "of the cohort median l2 "
+                        "(Config.screen_norm_mult)")
+    p.add_argument("--poison_rate", type=float, default=0.0,
+                   help="value-fault injection: per-round probability "
+                        "a sampled client's update is corrupted "
+                        "(deterministic in seed+round on its own PRNG "
+                        "domain; utils/faults.poison_mask)")
+    p.add_argument("--poison_kind", choices=list(POISON_KINDS),
+                   default="nan",
+                   help="corruption applied to a poisoned client's "
+                        "update: nan/inf overwrite it, scale "
+                        "multiplies by 2^40 (finite explosion — only "
+                        "the norm screen catches it)")
+    p.add_argument("--rollback_screen_rounds", type=int, default=8,
+                   help="after a numeric_trip rollback, force update "
+                        "screening on for this many rounds so the "
+                        "replayed fault is screened instead of "
+                        "re-tripping (Config.rollback_screen_rounds)")
+    p.add_argument("--max_numeric_rollbacks", type=int, default=2,
+                   help="cap on numeric_trip rollbacks per run; past "
+                        "it the driver fails loud instead of "
+                        "thrashing (Config.max_numeric_rollbacks)")
     p.add_argument("--keep_checkpoints", type=int, default=3,
                    help="keep the newest k rotated mid-run checkpoints "
                         "(utils/checkpoint.save_rotating)")

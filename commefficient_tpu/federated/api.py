@@ -54,7 +54,7 @@ from commefficient_tpu.parallel.plantransport import (
 from commefficient_tpu.telemetry.clients import ClientThroughputTracker
 from commefficient_tpu.telemetry.trace import TRACE
 from commefficient_tpu.utils.faults import (
-    FaultSchedule, InjectedFault, bernoulli_survivors,
+    FaultSchedule, InjectedFault, bernoulli_survivors, poison_mask,
     straggler_work_fractions,
 )
 from commefficient_tpu.utils.retry import is_transient_error, with_retries
@@ -250,6 +250,11 @@ class FedModel:
         # (utils/faults.FaultSchedule; set_fault_schedule)
         self._rounds_done = 0
         self.fault_schedule: Optional[FaultSchedule] = None
+        # finite-frontier rollback (ISSUE 16): rounds below this index
+        # dispatch with the admission screen FORCE-enabled — set by
+        # force_screen_rounds after a numeric-trip rollback so the
+        # replayed window screens the corruption out. 0 = no window.
+        self._screen_force_until = 0
         # observability (telemetry/): the throughput tracker always
         # exists (cheap arrays; its state rides in every checkpoint so
         # resume restores it even for runs that never journal), while
@@ -411,19 +416,31 @@ class FedModel:
             self.scheduler.load_replay_plans(plans)
 
     def _seal_plan(self, round_idx: int, client_ids,
-                   survivors, work, admits=()) -> None:
+                   survivors, work, admits=(), pois=None,
+                   screen=None) -> None:
         """Write-ahead seal of one round's control decision (ISSUE
         12): journal the `schedule` event (with the install digest
         when a transport or a replay stream is live), cross-check the
         digest against the replayed journal and the other
         controllers. Transport-free default runs with a default
         scheduler stash no fields and compute no digest — this is a
-        no-op there, bit-identically to the pre-feature build."""
+        no-op there, bit-identically to the pre-feature build.
+
+        pois/screen (ISSUE 16): a screened-family round's poison mask
+        and screen-enable flag are part of the control decision — they
+        ride the digest and the journaled record, so multi-controller
+        screened runs verify them like any other operand and a replay
+        with a diverged rollback window fails loud."""
         fields = self._plan_journal.pop(int(round_idx), None)
         digest = None
         if self.plan_transport is not None or self._replay_digests:
             digest = install_digest(round_idx, client_ids, survivors,
-                                    work, admits)
+                                    work, admits, poison=pois,
+                                    screen_on=screen)
+        if pois is not None and fields is not None:
+            fields["screen_on"] = float(screen) if screen is not None \
+                else None
+            fields["n_poisoned"] = int((np.asarray(pois) > 0).sum())
         if self._replay_digests:
             expect = self._replay_digests.pop(int(round_idx), None)
             if expect is not None and expect != digest:
@@ -559,7 +576,7 @@ class FedModel:
         cohort = jax.eval_shape(self._train_round.gather_fn,
                                 self.clients, rb.client_ids)
         out = {}
-        for variant, vb in audit_batch_variants(rb).items():
+        for variant, vb in audit_batch_variants(rb, self.cfg).items():
             out[variant] = jax.make_jaxpr(self._train_round.round_step)(
                 self.server, cohort, vb, lr, self._key)
         if include_span:
@@ -752,6 +769,55 @@ class FedModel:
         if work is not None and surv is None:
             surv = np.ones(work.shape[0], np.float32)
         return surv, work
+
+    # -- value-fault screening (ISSUE 16) --------------------------------
+    def _screened_dispatch(self, round_idx: int) -> bool:
+        """Whether dispatches at `round_idx` take the SCREENED program
+        family (round.SCREENED_PROGRAM_VARIANTS): screening or poison
+        configured statically, a scripted poison schedule installed,
+        or the round inside a post-rollback forced-screen window. A
+        default config outside any window builds the poison-free
+        treedef, so its three programs stay byte-identical."""
+        return (fround.screened_family(self.cfg)
+                or round_idx < self._screen_force_until
+                or (self.fault_schedule is not None
+                    and bool(self.fault_schedule.poison)))
+
+    def _poison_values(self, round_idx: int,
+                       num_slots: int) -> np.ndarray:
+        """[W] f32 {0,1} composed poison mask for one round: the
+        random Config.poison_rate draw (utils/faults.poison_mask, its
+        own PRNG domain — deterministic in (seed, round), so a resumed
+        run replays the identical faults) max-composed with any
+        scripted FaultSchedule.poison slots. All-zeros when nothing
+        poisons — the inert operand a screening-only round ships."""
+        mask = poison_mask(self.cfg.seed, round_idx, num_slots,
+                           self.cfg.poison_rate)
+        if self.fault_schedule is not None:
+            scripted = self.fault_schedule.poison_mask_for(round_idx,
+                                                           num_slots)
+            if scripted is not None:
+                mask = np.maximum(mask, scripted)
+        return mask
+
+    def _screen_flag(self, round_idx: int) -> np.float32:
+        """The traced screen-enable scalar for one round: 1.0 when the
+        admission screen applies (configured on, or the round is in a
+        forced post-rollback window), else 0.0 — poison then flows
+        through to the server state (the trip path)."""
+        on = (self.cfg.update_screen != "off"
+              or round_idx < self._screen_force_until)
+        return np.float32(1.0 if on else 0.0)
+
+    def force_screen_rounds(self, n: int) -> None:
+        """Force the in-round admission screen ON for the next `n`
+        dispatched rounds — the finite-frontier rollback's quarantine
+        window (Config.rollback_screen_rounds): after walking back to
+        a finite checkpoint, the replayed rounds re-draw the identical
+        poison (pure in (seed, round)) but the forced screen admits it
+        out, so the run crosses the trip boundary finitely."""
+        self._screen_force_until = max(
+            self._screen_force_until, self._rounds_done + int(n))
 
     # -- reference API surface -------------------------------------------
     def train(self, training: bool):
@@ -992,6 +1058,18 @@ class FedModel:
                     this_round, client_ids, data, mask, survivors,
                     work)
                 admits = self.async_admit.last_admits
+            # value-fault screening (ISSUE 16): a screened-family
+            # round always ships the full operand trio — ones-filled
+            # survivors, the composed poison mask, the traced screen
+            # flag — so exactly two screened programs exist and the
+            # per-round screen decision never retraces
+            pois = screen = None
+            if self._screened_dispatch(this_round):
+                W = np.asarray(client_ids).shape[0]
+                pois = self._poison_values(this_round, W)
+                screen = self._screen_flag(this_round)
+                if survivors is None:
+                    survivors = np.ones(W, np.float32)
             # write-ahead plan seal (ISSUE 12): digest + journal the
             # composed control decision, flush it durable before this
             # round's dispatch, and cross-check against the other
@@ -999,7 +1077,7 @@ class FedModel:
             # transport or replay stream (beyond the journaling the
             # scheduler always got).
             self._seal_plan(this_round, client_ids, survivors, work,
-                            admits)
+                            admits, pois=pois, screen=screen)
             self._flush_write_ahead()
 
         # tiered client state (ISSUE 11): assign device slots AFTER
@@ -1032,7 +1110,11 @@ class FedModel:
                 None if survivors is None
                 else mh.globalize(self.mesh, P(), survivors),
                 None if work is None
-                else mh.globalize(self.mesh, P(), work))
+                else mh.globalize(self.mesh, P(), work),
+                None if pois is None
+                else mh.globalize(self.mesh, P(), pois),
+                None if pois is None
+                else mh.globalize(self.mesh, P(), screen))
         self._rounds_staged = this_round + 1
         return _StagedRound(this_round, placed, lr,
                             np.asarray(client_ids), survivors,
@@ -1090,12 +1172,32 @@ class FedModel:
             bits = self._pack_bits(self.server.ps_weights
                                    - prev_weights)
             bits.copy_to_host_async()
+            # screened family (ISSUE 16): accounting charges the
+            # EFFECTIVE mask — host survivors x device admission — so
+            # a screened client is billed exactly like a dropped one.
+            # The device_get is a sync, but only screened configs ever
+            # take it; the default path reads the host copy as before.
+            surv_acc = staged.survivors
+            if metrics.admitted is not None:
+                surv_acc = np.asarray(jax.device_get(metrics.admitted),
+                                      np.float32)
             download, upload = self.accountant.record_round(
                 staged.client_ids,
                 None if self._prev_change_words is None
                 else np.asarray(self._prev_change_words),
-                survivors=staged.survivors)
+                survivors=surv_acc)
         self._prev_change_words = bits
+        if (metrics.admitted is not None and staged.survivors is not None
+                and self.telemetry is not None):
+            n_screened = int((staged.survivors > 0).sum()
+                             - (surv_acc > 0).sum())
+            if n_screened > 0:
+                self.telemetry.journal_event(
+                    "screened", round=this_round,
+                    n_screened=n_screened,
+                    kind=(self.cfg.update_screen
+                          if self.cfg.update_screen != "off"
+                          else "finite"))
 
         # telemetry, one-round lag (same discipline as the metric
         # return below): hand the session this round's DEVICE metric
@@ -1117,6 +1219,12 @@ class FedModel:
                 self.telemetry.journal_event(
                     "state_tier", round=this_round,
                     **self.state_store.take_journal_fields())
+                # checksummed tiers (ISSUE 16): any tail rows that
+                # failed verification since the last drain journal
+                # one loud `state_quarantine` event each
+                for q in self.state_store.take_quarantine_events():
+                    self.telemetry.journal_event(
+                        "state_quarantine", round=this_round, **q)
 
         # injected preemption: the round above fully completed (state,
         # accounting, round counter) — crash at the exact boundary a
@@ -1224,13 +1332,16 @@ class FedModel:
         # here) and the composed ids/data/mask rows replace the staged
         # ones — still a pure host-side merge on the cohort operands.
         surv_all = work_all = None
+        pois_all = screen_all = None
+        screened = self._screened_dispatch(first)
         span_idx = int(getattr(self, "_spans_dispatched", 0))
         if (self.cfg.client_dropout > 0 or self.cfg.straggler_rate > 0
                 or self.fault_schedule is not None
                 or self._scheduler_active()
                 or self.async_admit is not None
                 or self.plan_transport is not None
-                or self._replay_digests):
+                or self._replay_digests
+                or screened):
             # graftscope: the whole span's per-round composition is
             # ONE `plan` stage span (tagged with the first round)
             with TRACE.span("plan", round=first, span=span_idx):
@@ -1269,24 +1380,43 @@ class FedModel:
                             for d, d_n in zip(data, data_n):
                                 d[n] = d_n
                             mask[n] = mask_n
+                    # screened family (ISSUE 16): per-round poison
+                    # mask + screen flag ride the scanned program as
+                    # [N, W]/[N] operands; a forced-screen window
+                    # ending mid-span just flips the DATA flag — one
+                    # scanned program either way
+                    pois_n = screen_n = None
+                    if screened:
+                        W_n = np.asarray(ids_host[n]).shape[0]
+                        pois_n = self._poison_values(first + n, W_n)
+                        screen_n = self._screen_flag(first + n)
+                        if s is None:
+                            s = np.ones(W_n, np.float32)
                     # write-ahead seal per round (ISSUE 12): the whole
                     # span's sealed records flush as one barrier
                     # below, still BEFORE the span's dispatch
                     self._seal_plan(first + n, ids_host[n], s, w,
-                                    admits)
-                    rows.append((s, w))
+                                    admits, pois=pois_n,
+                                    screen=screen_n)
+                    rows.append((s, w, pois_n, screen_n))
                 ones = np.ones(ids_host.shape[1], np.float32)
-                if any(w is not None for _, w in rows):
+                if any(w is not None for _, w, _, _ in rows):
                     work_all = np.stack(
                         [w if w is not None else ones
-                         for _, w in rows])
+                         for _, w, _, _ in rows])
                     surv_all = np.stack(
                         [s if s is not None else ones
-                         for s, _ in rows])
-                elif any(s is not None for s, _ in rows):
+                         for s, _, _, _ in rows])
+                elif any(s is not None for s, _, _, _ in rows):
                     surv_all = np.stack(
                         [s if s is not None else ones
-                         for s, _ in rows])
+                         for s, _, _, _ in rows])
+                if screened:
+                    pois_all = np.stack([p for _, _, p, _ in rows])
+                    screen_all = np.asarray(
+                        [f for _, _, _, f in rows], np.float32)
+                    if surv_all is None:
+                        surv_all = np.stack([ones] * n_rounds)
 
         # tiered client state (ISSUE 11): the span executes as ONE
         # device program with the working-set block on the scan carry,
@@ -1349,7 +1479,11 @@ class FedModel:
                     None if surv_all is None
                     else mh.globalize(self.mesh, P(), surv_all),
                     None if work_all is None
-                    else mh.globalize(self.mesh, P(), work_all)),
+                    else mh.globalize(self.mesh, P(), work_all),
+                    None if pois_all is None
+                    else mh.globalize(self.mesh, P(), pois_all),
+                    None if screen_all is None
+                    else mh.globalize(self.mesh, P(), screen_all)),
                 mh.globalize(self.mesh, P(), lrs), self._key)
 
         def _journal_retry(attempt: int, exc: BaseException,
@@ -1448,9 +1582,31 @@ class FedModel:
                 # _call_train)
                 self._prev_change_words = jax.device_get(
                     self._prev_change_words)
+            # screened family (ISSUE 16): the span's per-round
+            # admitted rows replace the host survivor rows for
+            # accounting (the bits transfer above already forced the
+            # span, so this gather adds no sync) and journal one
+            # `screened` event per round that screened anyone
+            admitted_rows = None
+            if metrics.admitted is not None:
+                admitted_rows = np.asarray(
+                    mh.gather_host(metrics.admitted), np.float32)
             comm_rows = []
             for n in range(ids_host.shape[0]):
                 surv_n = None if surv_all is None else surv_all[n]
+                if admitted_rows is not None:
+                    if (self.telemetry is not None
+                            and surv_n is not None):
+                        n_scr = int((surv_n > 0).sum()
+                                    - (admitted_rows[n] > 0).sum())
+                        if n_scr > 0:
+                            self.telemetry.journal_event(
+                                "screened", round=first + n,
+                                n_screened=n_scr,
+                                kind=(self.cfg.update_screen
+                                      if self.cfg.update_screen
+                                      != "off" else "finite"))
+                    surv_n = admitted_rows[n]
                 if account:
                     d, u = self.accountant.record_round(
                         ids_host[n], self._prev_change_words,
@@ -1500,6 +1656,9 @@ class FedModel:
                     "state_tier", first_round=first,
                     rounds=int(ids_host.shape[0]),
                     **self.state_store.take_journal_fields())
+                for q in self.state_store.take_quarantine_events():
+                    self.telemetry.journal_event(
+                        "state_quarantine", first_round=first, **q)
 
         if crash_at is not None:
             # every completed round's state/accounting landed above —

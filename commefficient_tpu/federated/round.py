@@ -137,12 +137,33 @@ class RoundBatch(NamedTuple):
     the original mask-free one), so straggler machinery is free when
     disabled. Below-cutoff fractions never appear here: the host
     (api._faults_for_round) degrades them to dropout and re-normalizes
-    an all-ones work vector back to None."""
+    an all-ones work vector back to None.
+
+    poison: optional [num_workers] f32 {0,1} — value-fault injection
+    (ISSUE 16, Config.poison_rate / utils.faults.FaultSchedule.
+    poison). A flagged client's TRANSMITTED update is corrupted
+    device-side per Config.poison_kind after its local compute (its
+    losses and persistent state rows stay clean — only the wire is
+    poisoned). Presence of this operand selects the SCREENED program
+    family: the host supplies it (zeros-filled) whenever screening or
+    poisoning is configured, together with a survivors operand
+    (ones-filled) and the `screen` flag below. None — the default —
+    keeps the three original programs byte-identical.
+
+    screen: optional scalar f32 {0,1} — whether the in-round
+    admission screen APPLIES this round. Traced as data (not static
+    config) so the finite-frontier rollback can force screening on
+    for Config.rollback_screen_rounds without retracing, and a
+    poison-only run (screen 0) lets the corruption through to the
+    server state — the injection path the numeric-trip drill
+    exercises. Rides if-and-only-if `poison` does."""
     client_ids: jax.Array        # [num_workers] int32
     data: Tuple[jax.Array, ...]  # pytree of [num_workers, B, ...]
     mask: jax.Array              # [num_workers, B] f32 validity
     survivors: Optional[jax.Array] = None  # [num_workers] f32 or None
     work: Optional[jax.Array] = None       # [num_workers] f32 or None
+    poison: Optional[jax.Array] = None     # [num_workers] f32 or None
+    screen: Optional[jax.Array] = None     # scalar f32 or None
 
 
 class RoundMetrics(NamedTuple):
@@ -151,11 +172,19 @@ class RoundMetrics(NamedTuple):
     (zero-size when Config.telemetry is off, so the treedef per config
     is stable) — pure observation computed from values the round
     already produced; it feeds nothing back, so ServerState is
-    bit-identical with telemetry on or off."""
+    bit-identical with telemetry on or off.
+
+    admitted: the EFFECTIVE survivor mask after in-round admission
+    ([num_workers] f32 {0,1}; screened-family programs only, None —
+    no new leaves — everywhere else). host survivors x device admit:
+    the mask accounting and the journal must see so a screened client
+    is charged exactly like a dropped one (federated/api reads it
+    back at commit/collect time)."""
     losses: jax.Array            # [num_workers] per-client mean loss
     metrics: Tuple[jax.Array, ...]  # per-client means, each [num_workers]
     num_examples: jax.Array      # [num_workers]
     telemetry: jax.Array = None  # [telemetry.metrics.NUM_METRICS] or [0]
+    admitted: Optional[jax.Array] = None  # [num_workers] f32 or None
 
 
 def init_server_state(cfg: Config, ps_weights: jax.Array,
@@ -271,6 +300,23 @@ def client_state_rows(cfg: Config, num_clients: int) -> int:
 # programs whose operands may carry the population dimension.
 PROGRAM_VARIANTS = ("mask_free", "dropout", "dropout_stragglers")
 
+# ISSUE 16 screened family: when value-fault screening OR poison
+# injection is configured the host supplies the survivor mask
+# (ones-filled), a poison mask (zeros-filled), and the traced
+# screen-enable scalar on EVERY dispatch, so exactly two programs
+# exist — screened, and screened+stragglers — and the per-round
+# decision "does the admission screen apply" is data, never a
+# retrace. Default configs never build this treedef, keeping the
+# three programs above byte-identical.
+SCREENED_PROGRAM_VARIANTS = ("screened", "screened_stragglers")
+
+# multiplier applied by the "scale" poison kind: large enough that a
+# single poisoned client blows past any sane norm screen and (through
+# error feedback) trips the finite/driver telemetry watch, small
+# enough to stay finite in f32 so the norm screen (not just the
+# finite screen) is what catches it.
+POISON_SCALE = 2.0 ** 40
+
 # the two state-motion programs every TrainRound dispatch brackets the
 # round program with (compiled once; cache hits thereafter)
 STATE_MOTION_PROGRAMS = ("gather", "scatter")
@@ -291,8 +337,29 @@ SCATTER_DEAD_ARGNUMS = (0,)    # scatter-back: the full ClientState
 SPAN_DEAD_ARGNUMS = (0, 1)
 
 
+def screened_family(cfg: Config) -> bool:
+    """Whether `cfg` steady-state dispatches the SCREENED program
+    family (in-round admission and/or value-fault injection
+    configured). A default config can still dispatch screened
+    programs transiently — the finite-frontier rollback force-enables
+    screening for a bounded window — but its audited steady-state
+    program set is the three defaults."""
+    return cfg.update_screen != "off" or cfg.poison_rate > 0
+
+
+def program_variants_for(cfg: Config) -> tuple:
+    """The steady-state traced round-program set for `cfg` — the
+    contract surface graftaudit/graftmesh walk and the program-count
+    pins assert."""
+    return (SCREENED_PROGRAM_VARIANTS if screened_family(cfg)
+            else PROGRAM_VARIANTS)
+
+
 def program_variant(batch: RoundBatch) -> str:
-    """Which of the three traced programs `batch`'s treedef selects."""
+    """Which traced program `batch`'s treedef selects."""
+    if batch.poison is not None:
+        return ("screened_stragglers" if batch.work is not None
+                else "screened")
     if batch.work is not None:
         return "dropout_stragglers"
     if batch.survivors is not None:
@@ -300,18 +367,35 @@ def program_variant(batch: RoundBatch) -> str:
     return "mask_free"
 
 
-def audit_batch_variants(batch: RoundBatch) -> dict:
-    """The three RoundBatch treedef variants derived from one concrete
-    batch — the exact programs a run with dropout/stragglers enabled
-    dispatches. Survivor/work operands are inert values (all-survive,
-    half-work) chosen only to pin the treedef; graftaudit traces each
-    variant abstractly so the values never execute."""
+def audit_batch_variants(batch: RoundBatch,
+                         cfg: Optional[Config] = None) -> dict:
+    """The RoundBatch treedef variants derived from one concrete
+    batch — the exact programs a run with the config's fault machinery
+    enabled dispatches: the three default programs, or (when `cfg` is
+    given and selects the screened family) the two screened ones.
+    Survivor/work/poison operands are inert values (all-survive,
+    half-work, poison-nobody, screen-on) chosen only to pin the
+    treedef; graftaudit traces each variant abstractly so the values
+    never execute."""
     ones = jnp.ones(batch.client_ids.shape[0], jnp.float32)
+    if cfg is not None and screened_family(cfg):
+        zeros = jnp.zeros_like(ones)
+        on = jnp.ones((), jnp.float32)
+        return {
+            "screened": batch._replace(
+                survivors=ones, work=None, poison=zeros, screen=on),
+            "screened_stragglers": batch._replace(
+                survivors=ones, work=ones * 0.5, poison=zeros,
+                screen=on),
+        }
     return {
-        "mask_free": batch._replace(survivors=None, work=None),
-        "dropout": batch._replace(survivors=ones, work=None),
+        "mask_free": batch._replace(survivors=None, work=None,
+                                    poison=None, screen=None),
+        "dropout": batch._replace(survivors=ones, work=None,
+                                  poison=None, screen=None),
         "dropout_stragglers": batch._replace(survivors=ones,
-                                             work=ones * 0.5),
+                                             work=ones * 0.5,
+                                             poison=None, screen=None),
     }
 
 
@@ -330,7 +414,9 @@ def stack_batch_for_span(batch: RoundBatch, n_rounds: int) -> RoundBatch:
         jax.tree.map(stack, batch.data),
         stack(batch.mask),
         stack(batch.survivors),
-        stack(batch.work))
+        stack(batch.work),
+        stack(batch.poison),
+        stack(batch.screen))
 
 
 def make_round_fns(loss_fn: fclient.LossFn, unravel: Callable,
@@ -383,7 +469,8 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
 
     # ---------------- per-shard client phase ----------------------------
     def shard_train(ps_weights, data, mask, err_rows, vel_rows, w_rows,
-                    keys, lr, surv=None, work=None):
+                    keys, lr, surv=None, work=None, pois=None,
+                    screen=None):
         """Runs on one shard: simulate W = num_workers/n_shards clients
         (vmap), locally sum their compressed updates, psum across the
         clients axis (the reference's per-GPU client loop
@@ -406,7 +493,22 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         For fedavg the fraction is a completed-steps budget applied
         inside fedavg_step instead (truncating the dataset would
         change WHICH examples every epoch sees, not how far local
-        training got)."""
+        training got).
+
+        pois/screen (ISSUE 16, screened family only — ride together):
+        pois is the [W_shard] f32 {0,1} value-fault mask; a flagged
+        client's TRANSMIT is corrupted per Config.poison_kind after
+        its local compute, so losses/metrics/state rows stay clean.
+        screen is the traced scalar admission flag: when > 0 the
+        per-client admit mask (finite check over every transmit leaf,
+        plus the cohort-median norm-outlier check under
+        update_screen=norm) multiplies into the survivor mask BEFORE
+        aggregation — a screened client takes the dropped-client path
+        exactly. When 0 the admit mask is computed but NOT applied,
+        so injected corruption reaches the server (the rollback
+        drill's trip path). NaN-safety: screened-family aggregation
+        zeroes excluded clients with `where`, never multiplication
+        (NaN * 0 is NaN)."""
         # Cast the replicated weights to shard-varying before any
         # jax.grad: differentiating w.r.t. an *unvarying* operand under
         # shard_map makes JAX psum the cotangent across shards (correct
@@ -451,8 +553,11 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
 
         # only the client-compute step branches; the encode/psum
         # aggregation tail below is shared, so the fused and
-        # per-client paths cannot drift apart
-        if cfg.fused_client_backward:
+        # per-client paths cannot drift apart. The screened family
+        # needs per-client transmits (poison lands on the wire, the
+        # admit mask inspects it), so it always takes the per-client
+        # path even on fused-eligible configs.
+        if cfg.fused_client_backward and pois is None:
             # one backward for the whole shard (gate guarantees
             # equality with the per-client path — Config property and
             # fclient.fused_shard_grads docstrings); survivors weight
@@ -470,7 +575,78 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             else:
                 results, new_w_rows = jax.vmap(one_client)(
                     data, mask, err_rows, vel_rows, w_rows, keys)
-            if surv is not None:
+            if pois is not None:
+                # ---- screened family (ISSUE 16) ----
+                # value-fault injection first: corrupt flagged
+                # clients' transmits. With an all-zero mask every
+                # `where` passes the clean value through bit-exactly,
+                # so a screened run without live poison computes the
+                # identical wire values.
+                def corrupt(t):
+                    flag = pois.reshape(
+                        pois.shape + (1,) * (t.ndim - 1)) > 0
+                    if cfg.poison_kind == "scale":
+                        return t * jnp.where(
+                            flag, jnp.asarray(POISON_SCALE, t.dtype),
+                            jnp.ones((), t.dtype))
+                    bad = (jnp.inf if cfg.poison_kind == "inf"
+                           else jnp.nan)
+                    return jnp.where(flag, jnp.asarray(bad, t.dtype), t)
+                tx = jax.tree.map(corrupt, results.transmit)
+
+                # admission screen: per-client finite bit over every
+                # transmit leaf ...
+                leaves = jax.tree.leaves(tx)
+                ok = None
+                for t in leaves:
+                    fin_t = jnp.isfinite(t).reshape(
+                        t.shape[0], -1).all(axis=1)
+                    ok = fin_t if ok is None else ok & fin_t
+                if cfg.update_screen == "norm":
+                    # ... plus the norm-outlier check: update l2
+                    # against the COHORT median (all_gather across the
+                    # clients axis so every shard sees the same
+                    # median). Only surviving, finite, nonzero-l2
+                    # clients are eligible median material; a round
+                    # with no eligible clients admits everyone rather
+                    # than comparing against NaN.
+                    l2sq = None
+                    for t in leaves:
+                        s = jnp.square(t.astype(jnp.float32)).reshape(
+                            t.shape[0], -1).sum(axis=1)
+                        l2sq = s if l2sq is None else l2sq + s
+                    l2 = jnp.sqrt(l2sq)
+                    all_l2 = jax.lax.all_gather(
+                        l2, "clients").reshape(-1)
+                    all_surv = jax.lax.all_gather(
+                        surv, "clients").reshape(-1)
+                    elig = ((all_surv > 0) & jnp.isfinite(all_l2)
+                            & (all_l2 > 0))
+                    med = jnp.nanmedian(
+                        jnp.where(elig, all_l2, jnp.nan))
+                    norm_ok = jnp.where(
+                        elig.sum() > 0,
+                        l2 <= cfg.screen_norm_mult * med, True)
+                    ok = ok & norm_ok
+                # the traced enable flag: screen off -> admit mask
+                # computed but not applied (corruption flows through
+                # to the server state — the trip-drill injection path)
+                admit = jnp.where(screen > 0,
+                                  ok.astype(jnp.float32), 1.0)
+                surv_eff = surv * admit
+                # `where`, NOT multiplication: a poisoned excluded
+                # client's NaN/Inf must become an exact zero in the
+                # local sum (NaN * 0 is NaN) — this is also what makes
+                # a screened client bit-identical to a dropped one
+                local_sum = jax.tree.map(
+                    lambda t: jnp.where(
+                        surv_eff.reshape(
+                            surv_eff.shape + (1,) * (t.ndim - 1)) > 0,
+                        t, jnp.zeros_like(t)).sum(axis=0),
+                    tx)
+                counts = results.num_examples * surv_eff
+                admitted = surv_eff
+            elif surv is not None:
                 # zero dropped clients' uploads BEFORE the local sum —
                 # the psum'd aggregate and the divide-by-total see
                 # survivors only (survivor-count reweighting)
@@ -509,8 +685,14 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                                        cfg.sketch_table_dtype)
         transmit = jax.lax.psum(local_sum, "clients")
         total = jax.lax.psum(counts.sum(), "clients")
-        return (transmit, total, new_err, new_vel, new_w_rows,
-                losses, metrics, counts)
+        out = (transmit, total, new_err, new_vel, new_w_rows,
+               losses, metrics, counts)
+        if pois is not None:
+            # screened programs additionally report the effective
+            # (post-admission) survivor mask so the host accounting
+            # and journal charge screened clients as dropped ones
+            out = out + (admitted,)
+        return out
 
     state_spec = P("clients")
 
@@ -555,6 +737,39 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                   P("clients"), P("clients")),
         out_specs=(P(), P(), state_spec, state_spec, state_spec,
                    P("clients"), P("clients"), P("clients")),
+        axis_names=frozenset({"clients"}),
+    )
+
+    # screened family (ISSUE 16): survivors + poison mask + traced
+    # screen-enable scalar, with the effective admitted mask as a
+    # ninth output. Two programs — with and without the straggler
+    # work operand — mirroring the default family's structure so
+    # screening composes with every fault axis for free.
+    def _shard_train_screened(ps_weights, data, mask, err_rows,
+                              vel_rows, w_rows, keys, lr, surv, pois,
+                              screen):
+        return shard_train(ps_weights, data, mask, err_rows, vel_rows,
+                           w_rows, keys, lr, surv, None, pois, screen)
+
+    shard_train_screened_mapped = shard_map(
+        _shard_train_screened, mesh=mesh,
+        in_specs=(P(), P("clients"), P("clients"), P("clients"),
+                  P("clients"), P("clients"), P("clients"), P(),
+                  P("clients"), P("clients"), P()),
+        out_specs=(P(), P(), state_spec, state_spec, state_spec,
+                   P("clients"), P("clients"), P("clients"),
+                   P("clients")),
+        axis_names=frozenset({"clients"}),
+    )
+
+    shard_train_screened_work_mapped = shard_map(
+        shard_train, mesh=mesh,
+        in_specs=(P(), P("clients"), P("clients"), P("clients"),
+                  P("clients"), P("clients"), P("clients"), P(),
+                  P("clients"), P("clients"), P("clients"), P()),
+        out_specs=(P(), P(), state_spec, state_spec, state_spec,
+                   P("clients"), P("clients"), P("clients"),
+                   P("clients")),
         axis_names=frozenset({"clients"}),
     )
 
@@ -624,7 +839,39 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
 
         surv = batch.survivors
         work = batch.work
-        if work is not None:
+        pois = batch.poison
+        admitted = None
+        if pois is not None:
+            # screened family (ISSUE 16): survivors and the traced
+            # screen flag always ride with the poison operand (the
+            # host ones-fills / zero-fills whichever is inert) — two
+            # programs total, and the per-round screen decision is
+            # data, never a retrace (RoundBatch docstring)
+            surv = (jnp.ones(num_workers, jnp.float32) if surv is None
+                    else surv.astype(jnp.float32))
+            pois = pois.astype(jnp.float32)
+            screen = (jnp.ones((), jnp.float32)
+                      if batch.screen is None
+                      else jnp.asarray(batch.screen, jnp.float32))
+            if work is not None:
+                (transmit, total, new_err, new_vel, new_w, losses,
+                 metrics, counts,
+                 admitted) = shard_train_screened_work_mapped(
+                    server.ps_weights, batch.data, batch.mask,
+                    err_rows, vel_rows, w_rows, client_keys, lr, surv,
+                    work.astype(jnp.float32), pois, screen)
+            else:
+                (transmit, total, new_err, new_vel, new_w, losses,
+                 metrics, counts,
+                 admitted) = shard_train_screened_mapped(
+                    server.ps_weights, batch.data, batch.mask,
+                    err_rows, vel_rows, w_rows, client_keys, lr, surv,
+                    pois, screen)
+            # a fully-screened round is a zero-survivor round: the
+            # whole server update gates off and state comes through
+            # bit-untouched
+            alive = admitted.sum() > 0
+        elif work is not None:
             # stragglers active: the work program always carries a
             # survivor operand too (below-cutoff degradation composes
             # the two), so substitute ones when nothing dropped
@@ -687,7 +934,11 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         # merged CohortState is this program's carried row output —
         # the scatter-back state-motion program writes it into the
         # population blocks after dispatch.
-        keep = None if surv is None else surv[:, None] > 0
+        # the EFFECTIVE mask: host survivors x device admission —
+        # identical to surv outside the screened family, so the three
+        # default programs trace byte-identically
+        eff = admitted if admitted is not None else surv
+        keep = None if eff is None else eff[:, None] > 0
         new_cohort = cohort
         if _has_errors(cfg):
             if keep is not None:
@@ -720,13 +971,13 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                 losses=losses, counts=counts,
                 delta=new_ps - server.ps_weights,
                 verror=upd.Verror, vvelocity=upd.Vvelocity,
-                survivors=(jnp.float32(num_workers) if surv is None
-                           else surv.sum()))
+                survivors=(jnp.float32(num_workers) if eff is None
+                           else eff.sum()))
         else:
             tele = tmetrics.empty_vector()
 
         return new_server, new_cohort, RoundMetrics(
-            losses, metrics, counts, tele)
+            losses, metrics, counts, tele, admitted)
 
     def round_full(server: ServerState, clients: ClientState,
                    batch: RoundBatch, lr, key):

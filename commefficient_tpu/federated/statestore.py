@@ -58,6 +58,7 @@ from __future__ import annotations
 import errno
 import os
 import threading
+import zlib
 from collections import OrderedDict, deque
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -72,6 +73,16 @@ from commefficient_tpu.telemetry.trace import TRACE
 # the tracked client-state blocks, in ClientState field order — the
 # serialization contract shared with utils/checkpoint's crows_* keys
 STATE_FIELDS = ("errors", "velocities", "weights")
+
+
+def _row_crc(row: np.ndarray) -> int:
+    """CRC32 of one tail row's f32 bytes — the per-chunk checksum the
+    tiers record at spill time and verify at restore (ISSUE 16). f32
+    rows round-trip the host bit-exactly, so any mismatch is real
+    corruption (torn memmap page, bit rot, an external write), never
+    a representation artifact."""
+    return zlib.crc32(np.ascontiguousarray(
+        row, dtype=np.float32).tobytes()) & 0xFFFFFFFF
 
 
 def tracked_fields(cfg) -> Dict[str, bool]:
@@ -294,6 +305,19 @@ class TieredStateStore:
         # readable synchronously until the writer commits them to the
         # tail (the lock covers tail + pending, both threads touch)
         self._pending: Dict[int, Tuple[dict, int]] = {}
+        # per-row CRC32s of what the tail holds (ISSUE 16): cid ->
+        # field -> checksum, recorded at every tail write (spill
+        # commit on the writer thread, checkpoint/legacy imports on
+        # the staging thread) and verified at every tail read. Guarded
+        # by _lock like tail/pending (graftsync SHARED_STATE registry,
+        # analysis/domains) — both threads write it.
+        self._sums: Dict[int, Dict[str, int]] = {}
+        # quarantine events awaiting journal drain (take_quarantine_
+        # events): rows whose tail bytes failed verification and were
+        # re-initialized from the init base. Same _lock guard — the
+        # writer thread never appends today, but the list rides the
+        # same tail/sums transaction so the registry keeps it honest.
+        self._quarantined: List[dict] = []
         self._lock = threading.Lock()
         self._writer = _make_spill_writer(
             drain_timeout=float(getattr(cfg, "writer_drain_timeout_s",
@@ -323,9 +347,10 @@ class TieredStateStore:
         self.restores = 0
         self.spill_bytes = 0
         self.restore_bytes = 0
+        self.quarantines = 0
         self._emitted = {"hits": 0, "misses": 0, "spills": 0,
                          "restores": 0, "spill_bytes": 0,
-                         "restore_bytes": 0}
+                         "restore_bytes": 0, "quarantines": 0}
 
     # ---------------- planning (stage time, pure host) -------------------
     def plan_round(self, client_ids,
@@ -447,15 +472,76 @@ class TieredStateStore:
         def commit():
             host = {f: np.asarray(completers[f]())
                     for f in self.fields}
+            rows = {f: host[f][:len(ids)] for f in self.fields}
+            # per-row checksums BEFORE the lock (crc32 over host
+            # bytes, no shared state touched): the tail write and the
+            # sums record then land in one locked transaction, so a
+            # concurrent verify can never see a row without its sum
+            sums = {cid: {f: _row_crc(rows[f][i])
+                          for f in self.fields}
+                    for i, cid in enumerate(ids)}
             with self._lock:
-                self._tail.put(ids, {f: host[f][:len(ids)]
-                                     for f in self.fields})
+                self._tail.put(ids, rows)
+                self._sums.update(sums)
                 for cid in ids:
                     ent = self._pending.get(cid)
                     if ent is not None and ent[0] is completers:
                         del self._pending[cid]
 
         self._writer.submit(commit)
+
+    def _init_row(self, field: str) -> np.ndarray:
+        """The init-base row a quarantined (or never-seen) client's
+        field re-initializes from: zeros, except topk_down weights
+        which restore from the init-weights vector."""
+        if field == "weights" and self._init_weights is not None:
+            return np.array(self._init_weights, np.float32)
+        return np.zeros(self.D, np.float32)
+
+    def _verify_tail_bulk(self, ids, rows: dict) -> None:
+        """Checksum-verify tail rows (field -> [n, D], copies from a
+        get_many) against the sums recorded at spill time. LOCK HELD.
+        A mismatching field is QUARANTINED: re-initialized from its
+        init base in place, healed back into the tail with a fresh
+        sum (so one corruption fires one event, not one per read),
+        counted, and queued for the `state_quarantine` journal drain
+        (take_quarantine_events). Rows with no recorded sum — a
+        legacy import, or a pre-16 resume — verify vacuously:
+        unknown-but-loadable, matching the checkpoint manifest's
+        missing-finite-bit contract."""
+        for i, cid in enumerate(int(c) for c in ids):
+            expect = self._sums.get(cid)
+            if not expect:
+                continue
+            bad = [f for f in self.fields
+                   if f in expect and _row_crc(rows[f][i]) != expect[f]]
+            if not bad:
+                continue
+            # the three heal-writes below mutate guarded state; the
+            # guard is held by EVERY caller (_rows_for, the prefetch
+            # warm fill, checkpoint_rows — all call under
+            # `with self._lock:`), it just isn't lexical here, which
+            # is what SY001 checks
+            for f in bad:
+                rows[f][i] = self._init_row(f)
+                self.quarantines += 1
+                self._quarantined.append(  # graftsync: disable=SY001 -- caller holds self._lock
+                    {"client": cid, "field": f})
+            self._tail.put(  # graftsync: disable=SY001 -- caller holds self._lock
+                [cid], {f: rows[f][i][None] for f in self.fields})
+            self._sums[cid] = {  # graftsync: disable=SY001 -- caller holds self._lock
+                f: _row_crc(rows[f][i]) for f in self.fields}
+
+    def _verify_tail_row(self, cid: int, rows: dict) -> dict:
+        """Single-client wrapper over _verify_tail_bulk (LOCK HELD);
+        returns verified (possibly re-initialized) rows. Copies first:
+        a RAM tail's get() hands back table views, and verification
+        must never scribble re-init values through a view before the
+        heal-write commits them."""
+        stacked = {f: np.array(rows[f], np.float32)[None]
+                   for f in self.fields}
+        self._verify_tail_bulk([cid], stacked)
+        return {f: stacked[f][0] for f in self.fields}
 
     def _rows_for(self, cid: int) -> dict:
         """The authoritative host-side rows (ALL tracked fields at
@@ -470,7 +556,10 @@ class TieredStateStore:
             ent = self._pending.get(cid)
             warm = self._warm.get(cid)
             if ent is None and warm is None and self._tail.has(cid):
-                return self._tail.get(cid)
+                # checksum-verify-before-restore (graftsync ORDERING_
+                # EDGES): the tail bytes are validated HERE, before
+                # this row can reach the restore scatter below
+                return self._verify_tail_row(cid, self._tail.get(cid))
         if ent is not None:
             completers, i = ent
             return {f: np.asarray(completers[f]())[i]
@@ -538,7 +627,8 @@ class TieredStateStore:
                     self._warm[cid] = rows
             elif in_tail:
                 with self._lock:
-                    self._warm[cid] = self._tail.get(cid)
+                    self._warm[cid] = self._verify_tail_row(
+                        cid, self._tail.get(cid))
             # never-seen clients restore from init — nothing to warm
         # the cache is consumed by _rows_for and bounded: drop entries
         # once it exceeds a few cohorts' worth (under the guard — the
@@ -555,11 +645,21 @@ class TieredStateStore:
         totals = {"hits": self.hits, "misses": self.misses,
                   "spills": self.spills, "restores": self.restores,
                   "spill_bytes": self.spill_bytes,
-                  "restore_bytes": self.restore_bytes}
+                  "restore_bytes": self.restore_bytes,
+                  "quarantines": self.quarantines}
         out = {k: totals[k] - self._emitted[k] for k in totals}
         self._emitted = totals
         out["resident"] = len(self._lru)
         out["working_set"] = self.slots
+        return out
+
+    def take_quarantine_events(self) -> List[dict]:
+        """Drain the pending quarantine records — one {client, field}
+        dict per re-initialized row — for the caller to journal as
+        `state_quarantine` events (federated/api does this at the
+        same boundary it journals `state_tier` deltas)."""
+        with self._lock:
+            out, self._quarantined = self._quarantined, []
         return out
 
     # ---------------- checkpoint round-trip (bit-exact) -------------------
@@ -628,10 +728,14 @@ class TieredStateStore:
             np.int64, count=int(res_mask.sum()))
         evicted_sel = all_ids[~res_mask]
         with self._lock:
-            tail_rows = (self._tail.get_many(evicted_sel)
-                         if len(evicted_sel)
-                         else {f: np.zeros((0, self.D), np.float32)
-                               for f in self.fields})
+            if len(evicted_sel):
+                tail_rows = self._tail.get_many(evicted_sel)
+                # the checkpoint payload must carry VERIFIED rows — a
+                # corrupt tail row is quarantined here, not persisted
+                self._verify_tail_bulk(evicted_sel, tail_rows)
+            else:
+                tail_rows = {f: np.zeros((0, self.D), np.float32)
+                             for f in self.fields}
         empty = np.zeros((0,), np.float32)
         for name in STATE_FIELDS:
             if name not in self.fields:
@@ -680,10 +784,15 @@ class TieredStateStore:
         # million-client payload must not loop per row in Python
         tail_mask = ~np.isin(ids, lru_ids)
         if tail_mask.any():
+            tail_ids = ids[tail_mask]
+            tail_vals = {name: field_rows[name][tail_mask]
+                         for name in self.fields}
+            sums = {int(cid): {f: _row_crc(tail_vals[f][i])
+                               for f in self.fields}
+                    for i, cid in enumerate(tail_ids)}
             with self._lock:
-                self._tail.put(ids[tail_mask], {
-                    name: field_rows[name][tail_mask]
-                    for name in self.fields})
+                self._tail.put(tail_ids, tail_vals)
+                self._sums.update(sums)
         for cid, slot in zip(lru_ids, lru_slots):
             self._lru[int(cid)] = int(slot)
         used = set(self._lru.values())
@@ -720,10 +829,14 @@ class TieredStateStore:
             diff |= (block != init[None, :]).any(axis=1)
         touched = np.nonzero(diff)[0]
         if len(touched):
+            vals = {f: np.asarray(dense_rows[f][touched], np.float32)
+                    for f in self.fields}
+            sums = {int(cid): {f: _row_crc(vals[f][i])
+                               for f in self.fields}
+                    for i, cid in enumerate(touched)}
             with self._lock:
-                self._tail.put(touched, {
-                    f: np.asarray(dense_rows[f][touched], np.float32)
-                    for f in self.fields})
+                self._tail.put(touched, vals)
+                self._sums.update(sums)
         self._ever = set(int(c) for c in touched)
         self._ever_sorted = None
         return [int(c) for c in touched]
@@ -759,6 +872,7 @@ class TieredStateStore:
             self._tail.clear()
             self._pending.clear()
             self._warm.clear()
+            self._sums.clear()
 
     # ---------------- lifecycle ------------------------------------------
     def flush(self) -> None:

@@ -151,14 +151,22 @@ def plan_digest(plan) -> str:
 
 
 def install_digest(round_idx: int, client_ids, survivors, work,
-                   admits: Sequence = ()) -> str:
+                   admits: Sequence = (), poison=None,
+                   screen_on=None) -> str:
     """Digest of the control decision a process is about to EXECUTE:
     the post-composition cohort (ids after async admission), the
     survivor/work operands, and the admit merges themselves — the
     plan-carried form of the admission stream. Every controller must
     compute the identical value (transport.verify), and the value is
     write-ahead journaled so a deterministic restart can prove its
-    recomputed stream matches the pre-crash run's."""
+    recomputed stream matches the pre-crash run's.
+
+    poison/screen_on (ISSUE 16): a screened-family dispatch folds its
+    value-fault mask and its per-round screen-enable decision into the
+    digest too, so multi-controller screened runs stay digest-
+    consistent (a process whose rollback window diverged fails loud).
+    Left at None — every default-family dispatch — the digest bytes
+    are identical to the pre-feature build's."""
     obj = {
         "round": int(round_idx),
         "ids": [int(c) for c in np.asarray(client_ids).reshape(-1)],
@@ -167,6 +175,10 @@ def install_digest(round_idx: int, client_ids, survivors, work,
         "admits": [[int(s), int(c), float(np.float32(f)), int(o)]
                    for (s, c, f, o) in admits],
     }
+    if poison is not None or screen_on is not None:
+        obj["poison"] = _float_list(poison)
+        obj["screen_on"] = (None if screen_on is None
+                            else float(np.float32(screen_on)))
     return payload_digest(json.dumps(
         obj, sort_keys=True, separators=(",", ":")).encode())
 

@@ -45,10 +45,36 @@ from commefficient_tpu.telemetry.journal import RunJournal, append_event
 from commefficient_tpu.telemetry.trace import TRACE
 
 __all__ = [
-    "ClientThroughputTracker", "RunJournal", "TRACE",
-    "TelemetrySession", "append_event", "attach_run_telemetry",
-    "parse_profile_spans", "tmetrics",
+    "ClientThroughputTracker", "NumericTripError", "RunJournal",
+    "TRACE", "TelemetrySession", "append_event",
+    "attach_run_telemetry", "parse_profile_spans", "tmetrics",
 ]
+
+# the telemetry metrics the finite-frontier watch trips on (ISSUE
+# 16): non-finite update or error-feedback l2 means corruption
+# reached the server state — the persistent-poison condition the
+# auto-rollback recovers from. Both are EXISTING metrics; the watch
+# adds no device work.
+WATCHED_METRICS = ("update_l2", "error_l2")
+
+
+class NumericTripError(RuntimeError):
+    """A watched telemetry metric went non-finite: value corruption
+    reached ServerState (error feedback makes it persistent —
+    PAPER.md). Raised by TelemetrySession at the round's one-lag
+    emission, AFTER the `numeric_trip` journal event is durable. The
+    drivers catch this, halt the span, roll back to the newest
+    finite checkpoint (utils/checkpoint.load_resilient with
+    require_finite) and resume with screening force-enabled
+    (FedModel.force_screen_rounds); Config.max_numeric_rollbacks
+    bounds the retries before failing loud."""
+
+    def __init__(self, round_idx: int, metrics=()):
+        super().__init__(
+            f"non-finite {'/'.join(metrics) or 'telemetry'} at round "
+            f"{round_idx}: value corruption reached the server state")
+        self.round_idx = int(round_idx)
+        self.metrics = tuple(metrics)
 
 
 def parse_profile_spans(spec: str) -> Optional[Tuple[int, int]]:
@@ -199,7 +225,7 @@ class TelemetrySession:
                       f"training continues, further failures silent")
                 self._journal_warned = True
 
-    def journal_event(self, kind: str, **fields) -> None:
+    def journal_event(self, kind: str, /, **fields) -> None:
         if self.journal is not None:
             self._safe_write(lambda: self.journal.event(kind, **fields))
 
@@ -293,11 +319,11 @@ class TelemetrySession:
                 and seconds > 0):
             self.tracker.update_round(ids, counts_h, seconds,
                                       scheduled=scheduled)
+        named = tmetrics.named(
+            None if vec is None else np.asarray(
+                self._materialize(vec), np.float32))
         if self.journal is not None:
             fields = {"round": round_idx}
-            named = tmetrics.named(
-                None if vec is None else np.asarray(
-                    self._materialize(vec), np.float32))
             if named:
                 fields["metrics"] = named
             if seconds is not None:
@@ -309,6 +335,35 @@ class TelemetrySession:
         # per-round boundary = the unscanned path's span boundary:
         # flush the stage spans this round produced as one batch
         self._flush_trace()
+        self._check_trip(round_idx, named)
+
+    def _check_trip(self, round_idx: int, named) -> None:
+        """The finite-frontier watch (ISSUE 16): a non-finite watched
+        metric journals a durable `numeric_trip` event and raises
+        NumericTripError for the driver's rollback handler. Armed
+        whenever telemetry metrics flow (no extra device work; every
+        process trips identically since all gather the same metrics);
+        disarmed during close() so a trailing flush cannot raise out
+        of the shutdown path."""
+        if not named or self._closed:
+            return
+        bad = [k for k in WATCHED_METRICS
+               if k in named and not np.isfinite(named[k])]
+        if not bad:
+            return
+        self.journal_event("numeric_trip", round=int(round_idx),
+                           metrics=bad)
+        if self.journal is not None:
+            self._safe_write(self.journal.flush)
+        raise NumericTripError(round_idx, bad)
+
+    def discard_pending(self) -> None:
+        """Drop the one-round-lag buffer WITHOUT journaling it — the
+        rollback path: the buffered round belongs to the halted
+        stream (and likely carries the same non-finite metrics that
+        tripped), so emitting it after the rollback would double-
+        count the trip against Config.max_numeric_rollbacks."""
+        self._pending = None
 
     def flush(self) -> None:
         """Drain the one-round-lag buffer (end of epoch/run; before a
@@ -389,6 +444,15 @@ class TelemetrySession:
         # any writer-thread spans committed since the last boundary)
         # land as one batched trace event — one additional fsync
         self._flush_trace()
+        # finite-frontier watch over the span's rows, in round order:
+        # the FIRST tripped round raises (its journal records above
+        # are already durable), matching the per-round path's boundary
+        if telemetry_rows is not None:
+            for i in range(n):
+                self._check_trip(
+                    int(first_round) + i,
+                    tmetrics.named(np.asarray(telemetry_rows[i],
+                                              np.float32)))
 
     # ---------------- profiler capture (--profile_spans) -----------------
     def span_profile_begin(self, span_idx: int) -> None:

@@ -70,6 +70,25 @@ consumers must tolerate kinds they don't know):
                           Perfetto-loadable Chrome trace and
                           summarize() turns into per-stage p50/p95 +
                           overlap efficiency
+  screened                value-fault screening (ISSUE 16,
+                          federated/round `update_screen`): one round
+                          admitted fewer clients than survived —
+                          `round`, `n_screened` (clients excluded by
+                          the in-round admission mask), `kind`
+                          ("finite" or "norm")
+  numeric_trip            the finite-frontier watch tripped: a
+                          watched telemetry metric (update_l2 /
+                          error_l2) went non-finite — `round`,
+                          `metrics` (the offending metric names).
+                          Opens a new validation SEGMENT like
+                          run_start: the driver rolls back to the
+                          newest finite checkpoint and legitimately
+                          replays rounds after this record
+  state_quarantine        a checksummed state-tier chunk failed
+                          verification at restore time
+                          (federated/statestore) and the row was
+                          re-initialized from its init base —
+                          `client`, `field`
   bench_digest / profile_digest  bench harness result records
   audit_digest            graftaudit's static cost report
                           (analysis/audit): sha256 `digest`,
@@ -90,6 +109,7 @@ consumers must tolerate kinds they don't know):
 from __future__ import annotations
 
 import json
+import math
 import os
 import queue
 import threading
@@ -142,6 +162,29 @@ def _finite(obj):
         return {k: _finite(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_finite(v) for v in obj]
+    return obj
+
+
+# inverse of NONFINITE: the exact sentinel strings _finite writes,
+# mapped back to the float values they stood for
+NONFINITE_INVERSE = {"NaN": math.nan, "Infinity": math.inf,
+                     "-Infinity": -math.inf}
+
+
+def _unfinite(obj):
+    """Inverse of `_finite`, applied by `read_journal` (ISSUE 16
+    satellite): the exact sentinel strings "NaN" / "Infinity" /
+    "-Infinity" round-trip back to floats, recursively, so consumers
+    (summarize, the rollback drill's resume-equivalence check,
+    np.isfinite over metrics) see numbers, not strings. Only the
+    three exact sentinels convert — every other string passes
+    through untouched. Dict KEYS are never rewritten."""
+    if isinstance(obj, str):
+        return NONFINITE_INVERSE.get(obj, obj)
+    if isinstance(obj, dict):
+        return {k: _unfinite(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unfinite(v) for v in obj]
     return obj
 
 
@@ -290,8 +333,11 @@ class RunJournal:
                     return {"round": v}
         return {}
 
-    def event(self, kind: str, **fields) -> dict:
-        """Append one record; returns the dict that was written."""
+    def event(self, kind: str, /, **fields) -> dict:
+        """Append one record; returns the dict that was written.
+        `kind` is positional-only: the `screened` event (ISSUE 16)
+        carries a FIELD named `kind`, which must stay usable as a
+        keyword."""
         rec = self._record(kind, fields)
         self._emit((json.dumps(_finite(rec), default=_jsonable),),
                    trace_tags=self._tags_of((rec,)))
@@ -335,7 +381,7 @@ class RunJournal:
             self._thread = None
 
 
-def append_event(path: str, kind: str, **fields) -> dict:
+def append_event(path: str, kind: str, /, **fields) -> dict:
     """One-shot append for producers without a long-lived journal
     (bench harness digests)."""
     return RunJournal(path).event(kind, **fields)
@@ -389,7 +435,7 @@ def read_journal(path: str,
         if not isinstance(rec, dict):
             _skip_or_problem(i, "not a JSON object")
             continue
-        records.append(rec)
+        records.append(_unfinite(rec))
     if counters is not None:
         counters["corrupt_interior"] = len(skipped)
         counters["corrupt_lines"] = list(skipped)
@@ -442,7 +488,15 @@ def validate_journal(path: str,
         analysis/syncaudit) carry a 64-hex string `digest`, a `rules`
         object mapping each SY rule to a non-negative integer count,
         and a non-negative integer `findings` — the record tier1's
-        sync step journals, so its shape must not rot.
+        sync step journals, so its shape must not rot;
+      * `screened` events (ISSUE 16 value-fault admission) carry an
+        integer `round`, a non-negative integer `n_screened`, and a
+        non-empty string `kind`;
+      * `numeric_trip` events carry an integer `round` and a list of
+        metric-name strings `metrics`; a trip also opens a new run
+        SEGMENT (see below) — the driver rolls back and replays;
+      * `state_quarantine` events carry a non-negative integer
+        `client` and a non-empty string `field`.
 
     A `run_start` event opens a new run SEGMENT and resets the round
     tracking: a preempted run resumed with the same --journal_path
@@ -478,6 +532,16 @@ def validate_journal(path: str,
             seen_rounds = set()
             last_round = None
             seg_down = seg_up = 0.0
+        if rec.get("event") == "numeric_trip":
+            # finite-frontier rollback (ISSUE 16): the driver walks
+            # back to the newest finite checkpoint and REPLAYS rounds
+            # after this record — round repeats across a trip are
+            # healthy history, exactly like a resume's run_start.
+            # Byte accumulation is NOT reset: the accountant keeps
+            # counting across the rollback, so run_end totals still
+            # cover every journaled per-round sum including replays.
+            seen_rounds = set()
+            last_round = None
         for field in REQUIRED_FIELDS:
             if field not in rec:
                 problems.append(f"record {n}: missing `{field}`")
@@ -547,6 +611,46 @@ def validate_journal(path: str,
             for field in ("spill_bytes", "restore_bytes",
                           "resident", "working_set"):
                 _comm_field(rec, n, field)
+        if rec.get("event") == "screened":
+            # value-fault admission (ISSUE 16): the record the drill
+            # matrix and the tier1 poisoned smoke read, so its shape
+            # must not rot
+            if not isinstance(rec.get("round"), int):
+                problems.append(
+                    f"record {n}: screened event without an integer "
+                    f"`round` (got {rec.get('round')!r})")
+            ns = rec.get("n_screened")
+            if not (isinstance(ns, int) and ns >= 0):
+                problems.append(
+                    f"record {n}: screened `n_screened` must be a "
+                    f"non-negative integer (got {ns!r})")
+            k2 = rec.get("kind")
+            if not (isinstance(k2, str) and k2):
+                problems.append(
+                    f"record {n}: screened event without a non-empty "
+                    f"string `kind` (got {k2!r})")
+        if rec.get("event") == "numeric_trip":
+            if not isinstance(rec.get("round"), int):
+                problems.append(
+                    f"record {n}: numeric_trip event without an "
+                    f"integer `round` (got {rec.get('round')!r})")
+            m2 = rec.get("metrics")
+            if not (isinstance(m2, list)
+                    and all(isinstance(x, str) for x in m2)):
+                problems.append(
+                    f"record {n}: numeric_trip `metrics` must be a "
+                    f"list of metric-name strings (got {m2!r})")
+        if rec.get("event") == "state_quarantine":
+            c2 = rec.get("client")
+            if not (isinstance(c2, int) and c2 >= 0):
+                problems.append(
+                    f"record {n}: state_quarantine `client` must be "
+                    f"a non-negative integer (got {c2!r})")
+            f2 = rec.get("field")
+            if not (isinstance(f2, str) and f2):
+                problems.append(
+                    f"record {n}: state_quarantine event without a "
+                    f"non-empty string `field` (got {f2!r})")
         # the two analysis-tier digest records share a shape: sha256
         # digest + per-program cost object, with tier-specific fields
         digest_fields = {
@@ -702,6 +806,7 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     deadlines = 0
     tier_hits = tier_misses = tier_spills = 0
     tier_spill_b = 0.0
+    screened_total = 0
     # trace spans SEGMENTED at run_start: monotonic t0 values share a
     # base only within one process lifetime, so the wall-extent math
     # (overlap efficiency) must never mix segments from a resumed run
@@ -727,6 +832,8 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
             d = rec.get("dropped")
             if isinstance(d, int) and d > 0:
                 trace_dropped += d
+        if kind == "screened":
+            screened_total += int(rec.get("n_screened", 0) or 0)
         if kind == "state_tier":
             tier_hits += int(rec.get("hits", 0) or 0)
             tier_misses += int(rec.get("misses", 0) or 0)
@@ -762,6 +869,15 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
         "up_mib": round(up_b / (1024 ** 2), 3),
         "deadline_rounds": deadlines,
     }
+    if (kinds.get("screened") or kinds.get("numeric_trip")
+            or kinds.get("state_quarantine")):
+        # numeric-robustness counters (ISSUE 16): how many client
+        # updates the in-round admission excluded, how many times the
+        # finite-frontier watch tripped (each trip = one rollback),
+        # and how many state-tier rows were quarantined at restore
+        out["screened_total"] = screened_total
+        out["numeric_trips"] = kinds.get("numeric_trip", 0)
+        out["state_quarantines"] = kinds.get("state_quarantine", 0)
     if tier_hits or tier_misses:
         # tiered client state (ISSUE 11): working-set hit rate +
         # spill traffic — the run's residency summary line

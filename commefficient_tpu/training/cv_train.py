@@ -40,7 +40,7 @@ from commefficient_tpu.federated.api import FedModel, FedOptimizer
 from commefficient_tpu.parallel import multihost as mh
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.training.scanloop import (
-    make_span_checkpoint, run_scanned_rounds,
+    make_span_checkpoint, numeric_rollback, run_scanned_rounds,
 )
 from commefficient_tpu.utils.checkpoint import (
     latest_checkpoint_path, load_checkpoint, load_resilient,
@@ -609,9 +609,30 @@ def main(argv=None) -> bool:
 
     ok = False
     try:
-        ok = train(model, opt, lr_scheduler, train_loader, val_loader,
-                   cfg, loggers=(TableLogger(),) if coord else (),
-                   timer=timer, log_dir=log_dir)
+        from commefficient_tpu.telemetry import NumericTripError
+        trips = 0
+        while True:
+            try:
+                ok = train(model, opt, lr_scheduler, train_loader,
+                           val_loader, cfg,
+                           loggers=(TableLogger(),) if coord else (),
+                           timer=timer, log_dir=log_dir)
+                break
+            except NumericTripError as trip:
+                # finite-frontier auto-rollback (ISSUE 16): the trip
+                # is already journaled durable; walk back to the
+                # newest finite checkpoint and replay with screening
+                # forced on. Bounded — exhausting the budget (or
+                # having no finite checkpoint) fails loud.
+                trips += 1
+                if trips > cfg.max_numeric_rollbacks:
+                    raise
+                sched_step = numeric_rollback(
+                    model, _ckpt_path(cfg), cfg, tele, trip)
+                if sched_step is None:
+                    raise
+                lr_scheduler.load_state_dict(
+                    {"step_count": sched_step})
         model.finalize()
 
         if cfg.do_checkpoint:

@@ -45,7 +45,7 @@ from commefficient_tpu.parallel.mesh import make_multihost_client_mesh
 from commefficient_tpu.parallel.tp import tp_loss
 from commefficient_tpu.telemetry.trace import TRACE
 from commefficient_tpu.training.scanloop import (
-    make_span_checkpoint, run_scanned_rounds,
+    make_span_checkpoint, numeric_rollback, run_scanned_rounds,
 )
 from commefficient_tpu.utils.cache import enable_persistent_compilation_cache
 from commefficient_tpu.utils.checkpoint import (
@@ -605,11 +605,30 @@ def main(argv=None) -> bool:
                       logger=TableLogger() if coord else NullLogger())
             ok = True
         else:
-            ok = train_gpt2(model, opt, lr_scheduler, train_loader,
-                            cfg,
-                            logger=TableLogger() if coord
-                            else NullLogger(),
-                            timer=timer, log_dir=log_dir)
+            from commefficient_tpu.telemetry import NumericTripError
+            trips = 0
+            while True:
+                try:
+                    ok = train_gpt2(model, opt, lr_scheduler,
+                                    train_loader, cfg,
+                                    logger=TableLogger() if coord
+                                    else NullLogger(),
+                                    timer=timer, log_dir=log_dir)
+                    break
+                except NumericTripError as trip:
+                    # finite-frontier auto-rollback (ISSUE 16),
+                    # shared contract with cv_train: walk back to
+                    # the newest finite checkpoint, replay with
+                    # screening forced on; bounded, then fail loud
+                    trips += 1
+                    if trips > cfg.max_numeric_rollbacks:
+                        raise
+                    sched_step = numeric_rollback(
+                        model, ckpt_path, cfg, tele, trip)
+                    if sched_step is None:
+                        raise
+                    lr_scheduler.load_state_dict(
+                        {"step_count": sched_step})
             save_checkpoint(os.path.join(log_dir, "gpt2"), model.server,
                             scheduler_step=lr_scheduler.step_count)
             if cfg.do_checkpoint:

@@ -217,6 +217,56 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
     return True
 
 
+def numeric_rollback(model, prefix: str, cfg, tele, trip):
+    """Finite-frontier auto-rollback (ISSUE 16), shared by both
+    drivers: after telemetry raises NumericTripError (a watched
+    update/error-l2 went non-finite; the `numeric_trip` journal
+    record is already durable), walk the checkpoint rotation back to
+    the newest entry whose manifest records FINITE state
+    (utils/checkpoint.load_resilient require_finite), restore it, and
+    force update screening on for the next cfg.rollback_screen_rounds
+    rounds so the replayed window admits out whatever poisoned the
+    frontier. The caller re-enters its training loop; the restored
+    round counter + sampler/scheduler cursors make the resumed stream
+    bit-exact from the rolled-back boundary.
+
+    Returns the restored scheduler step, or None when no finite
+    checkpoint exists — the caller re-raises the trip (fail loud
+    rather than train forward from a poisoned frontier)."""
+    from commefficient_tpu.parallel import multihost as mh
+    from commefficient_tpu.utils.checkpoint import load_resilient
+
+    model.drain_persistence()
+    if tele is not None:
+        # drop the one-round-lag metric buffer: it likely carries the
+        # same non-finite row and would re-trip against the rollback
+        # budget the moment training resumes
+        tele.discard_pending()
+    fallbacks = []
+    loaded = load_resilient(
+        prefix, expect_fingerprint=model.checkpoint_fingerprint,
+        on_fallback=lambda p, why: fallbacks.append((p, why)),
+        require_finite=True)
+    if tele is not None:
+        for p, why in fallbacks:
+            tele.journal_event("checkpoint_fallback", path=p,
+                               error=why[:200])
+    if loaded is None:
+        return None
+    path, ckpt = loaded
+    sched_step = model.load_state(ckpt)
+    # AFTER load_state: the forced-screen window counts from the
+    # restored round counter, covering exactly the replayed rounds
+    model.force_screen_rounds(cfg.rollback_screen_rounds)
+    if mh.is_coordinator():
+        print(f"numeric trip at round {trip.round_idx} "
+              f"({', '.join(trip.metrics) or 'telemetry'}): rolled "
+              f"back to {path} (round {int(ckpt.server.round_idx)}); "
+              f"update screening forced for "
+              f"{cfg.rollback_screen_rounds} rounds")
+    return sched_step
+
+
 def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
     """Build the drivers' shared `checkpoint` hook for
     run_scanned_rounds: a rotated save (utils/checkpoint.save_rotating)

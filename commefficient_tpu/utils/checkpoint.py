@@ -512,17 +512,30 @@ _NPZ_READ_ERRORS = (OSError, ValueError, KeyError, EOFError,
                     zipfile.BadZipFile)
 
 
-def file_checksums(path: str) -> Dict[str, int]:
-    """Per-array CRC32s of a checkpoint .npz, from the bytes ON DISK
-    (save_rotating re-reads the file it just wrote, so the manifest
-    checksums vouch for the written artifact, not the in-memory
-    arrays it came from)."""
+def file_integrity(path: str) -> Tuple[Dict[str, int], bool]:
+    """ONE read pass over a checkpoint .npz: per-array CRC32s plus a
+    finite verdict (every float-dtype array is all-finite). The bytes
+    come from DISK (save_rotating re-reads the file it just wrote, so
+    the manifest vouches for the written artifact, not the in-memory
+    arrays it came from); the finite bit rides the same pass because
+    a second full read at every rotation would double checkpoint IO.
+    The verdict feeds the manifest's `finite` map (ISSUE 16): the
+    rollback loader skips checkpoints recorded non-finite instead of
+    resuming into the same poisoned state it just tripped on."""
     out: Dict[str, int] = {}
+    finite = True
     with np.load(path) as z:
         for name in z.files:
-            out[name] = zlib.crc32(np.ascontiguousarray(
-                z[name]).tobytes()) & 0xFFFFFFFF
-    return out
+            a = np.ascontiguousarray(z[name])
+            out[name] = zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+            if finite and np.issubdtype(a.dtype, np.floating):
+                finite = bool(np.isfinite(a).all())
+    return out, finite
+
+
+def file_checksums(path: str) -> Dict[str, int]:
+    """Per-array CRC32s of a checkpoint .npz (see file_integrity)."""
+    return file_integrity(path)[0]
 
 
 def verify_checkpoint_file(path: str,
@@ -556,7 +569,9 @@ def verify_checkpoint_file(path: str,
 def load_resilient(prefix: str,
                    expect_fingerprint: Optional[dict] = None,
                    on_fallback: Optional[Callable[[str, str], None]]
-                   = None) -> Optional[Tuple[str, Checkpoint]]:
+                   = None,
+                   require_finite: bool = False
+                   ) -> Optional[Tuple[str, Checkpoint]]:
     """Corruption-tolerant auto-resume (ISSUE 12 satellite): walk the
     rotation newest-first — manifest history, then stamped files the
     manifest lost, then the legacy fixed name — integrity-checking
@@ -571,16 +586,26 @@ def load_resilient(prefix: str,
     corruption and re-raises immediately: silently falling back past a
     wrong-config checkpoint would resume from an ancestor of a
     different run. Returns (path, Checkpoint) or None when nothing
-    loadable exists."""
+    loadable exists.
+
+    `require_finite=True` (ISSUE 16 numeric rollback): ALSO skip any
+    candidate whose manifest `finite` entry records False — a save
+    that captured non-finite state, exactly what the rollback must
+    walk past. A MISSING finite entry (pre-16 manifest, or the
+    glob/fixed-name fallback with no manifest at all) means
+    unknown-but-loadable, so old rotations stay resumable; the
+    loaded arrays are the authority then."""
     ckpt_dir = os.path.dirname(prefix) or "."
     candidates: List[str] = []
     checksums: Dict[str, Dict[str, int]] = {}
+    finite_map: Dict[str, bool] = {}
     try:
         with open(_manifest_path(prefix)) as f:
             manifest = json.load(f)
         for base in manifest.get("history", []):
             candidates.append(os.path.join(ckpt_dir, base))
         checksums = manifest.get("checksums", {}) or {}
+        finite_map = manifest.get("finite", {}) or {}
     except (OSError, ValueError):
         pass
     # stamped files the manifest lost track of, newest first; then the
@@ -594,6 +619,15 @@ def load_resilient(prefix: str,
         candidates.append(fixed)
     for path in candidates:
         if not os.path.exists(path):
+            continue
+        if require_finite and \
+                finite_map.get(os.path.basename(path)) is False:
+            reason = ("manifest records non-finite state at save "
+                      "time (numeric rollback skips it)")
+            print(f"checkpoint fallback: skipping non-finite "
+                  f"{path!r}; trying the previous rotation")
+            if on_fallback is not None:
+                on_fallback(path, reason)
             continue
         try:
             verify_checkpoint_file(
@@ -620,7 +654,8 @@ def save_rotating(prefix: str, server: ServerState,
     keep-last-k pruning. Returns the written path.
 
     Files are `<prefix>-r<round:08d>.npz`; the manifest is JSON
-    {"latest": basename, "history": [basenames newest-first]} written
+    {"latest": basename, "history": [basenames newest-first],
+    "checksums": {...}, "finite": {basename: bool}} written
     atomically AFTER the checkpoint itself, so a preemption between
     the two leaves the manifest pointing at the previous (intact)
     file. Pruning removes only files the rotation itself wrote (they
@@ -643,11 +678,13 @@ def save_rotating(prefix: str, server: ServerState,
         mpath = _manifest_path(prefix)
         history = []
         old_sums: dict = {}
+        old_fin: dict = {}
         try:
             with open(mpath) as f:
                 m = json.load(f)
             history = list(m.get("history", []))
             old_sums = dict(m.get("checksums", {}) or {})
+            old_fin = dict(m.get("finite", {}) or {})
         except (OSError, ValueError):
             pass
         # entries stamped AFTER this round belong to an abandoned
@@ -674,14 +711,16 @@ def save_rotating(prefix: str, server: ServerState,
                 except OSError:
                     return False
             keep = [keep[0]] + [h for h in keep[1:] if fresh(h)]
-        # per-array checksums (ISSUE 12 satellite): computed by
-        # RE-READING the just-written file, so the manifest vouches
-        # for the bytes on disk — load_resilient verifies them at
-        # resume and falls back to the previous rotation on mismatch.
-        # Prior entries' sums carry forward; the dict is trimmed to
-        # the kept history so it cannot grow without bound.
+        # per-array checksums (ISSUE 12 satellite) + finite bit
+        # (ISSUE 16): computed in ONE pass by RE-READING the
+        # just-written file, so the manifest vouches for the bytes on
+        # disk — load_resilient verifies checksums at resume and
+        # falls back on mismatch, and the numeric-rollback loader
+        # (require_finite) walks past entries recording finite=False.
+        # Prior entries carry forward; both dicts are trimmed to the
+        # kept history so they cannot grow without bound.
         try:
-            old_sums[base] = file_checksums(path)
+            old_sums[base], old_fin[base] = file_integrity(path)
         except _NPZ_READ_ERRORS as e:
             # a checkpoint that cannot be re-read right after its
             # atomic replace is ALREADY corrupt — keep the manifest
@@ -690,9 +729,10 @@ def save_rotating(prefix: str, server: ServerState,
             print(f"checkpoint warning: cannot checksum just-written "
                   f"{path!r} ({e})")
         sums = {b: old_sums[b] for b in keep if b in old_sums}
+        fins = {b: old_fin[b] for b in keep if b in old_fin}
         _atomic_write_text(mpath, json.dumps(
-            {"latest": base, "history": keep, "checksums": sums},
-            indent=2))
+            {"latest": base, "history": keep, "checksums": sums,
+             "finite": fins}, indent=2))
         # prune every stamped file NOT in the kept history (not just
         # the manifest's own tail): a lost/corrupt manifest must not
         # orphan earlier stamped files forever, and stale

@@ -94,6 +94,29 @@ def straggler_work_fractions(seed: int, round_idx: int, num_workers: int,
     return np.where(is_straggler, frac, 1.0).astype(np.float32)
 
 
+def poison_mask(seed: int, round_idx: int, num_workers: int,
+                rate: float) -> np.ndarray:
+    """The production value-fault draw (ISSUE 16, Config.poison_rate):
+    [num_workers] f32 {0,1} mask, 1 marking a participant slot whose
+    transmitted update is CORRUPTED this round (Config.poison_kind
+    picks how — the jitted round applies it device-side, so the
+    injection exercises the same screened program a real bad update
+    would hit).
+
+    Same replay contract as `bernoulli_survivors`: a pure function of
+    (seed, round_idx) on its own counter-based generator and PRNG
+    domain, so the poison stream never aliases the dropout/straggler
+    streams and a rolled-back run re-poisons exactly the rounds the
+    original did (which is what makes the forced-screen resume
+    deterministic)."""
+    if rate <= 0.0:
+        return np.zeros(num_workers, np.float32)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed), DOMAINS["poison"],
+                                int(round_idx)]))
+    return (rng.random(num_workers) < rate).astype(np.float32)
+
+
 @dataclass(frozen=True)
 class FaultSchedule:
     """A deterministic script of failures for one training run.
@@ -112,6 +135,15 @@ class FaultSchedule:
                  single-step modes, local SGD steps for fedavg);
                  unlisted slots work at 1.0. Composes with the random
                  Config.straggler_rate draw by elementwise minimum.
+    poison:      {round_idx: participant SLOT indices whose update is
+                 CORRUPTED that round} — scripted value faults
+                 (ISSUE 16). The listed slots' transmitted updates
+                 are corrupted device-side per Config.poison_kind;
+                 composes with the random Config.poison_rate draw by
+                 elementwise maximum. Unlike drop/slow, a poisoned
+                 client still runs its round at full work — whether
+                 its corruption reaches the server state is exactly
+                 what Config.update_screen decides.
     crash_after: raise InjectedFault once the given round has fully
                  completed (state updated, accounting recorded) — the
                  preemption point a checkpoint/resume test recovers
@@ -161,6 +193,7 @@ class FaultSchedule:
     drop_slots: Mapping[int, Sequence[int]] = field(default_factory=dict)
     drop_all: Sequence[int] = ()
     slow: Mapping[int, Mapping[int, float]] = field(default_factory=dict)
+    poison: Mapping[int, Sequence[int]] = field(default_factory=dict)
     crash_after: Optional[int] = None
     crash_in_span: Optional[int] = None
     coordinator_crash_at: Optional[int] = None
@@ -212,6 +245,19 @@ class FaultSchedule:
                     "zero work use drop/drop_slots (dropout), or a "
                     "small fraction below Config.straggler_cutoff")
             out[int(slot)] = frac
+        return out
+
+    def poison_mask_for(self, round_idx: int,
+                        num_slots: int) -> Optional[np.ndarray]:
+        """[W] f32 {0,1} scripted poison mask for this round, or None
+        when the schedule poisons nobody in it. Slot-indexed like
+        drop_slots (tests care about position, not identity — the
+        drill scripts 'slot k of round r emits garbage')."""
+        slots = self.poison.get(int(round_idx))
+        if slots is None:
+            return None
+        out = np.zeros(num_slots, np.float32)
+        out[np.asarray(slots, np.int64)] = 1.0
         return out
 
     def should_crash(self, round_idx: int) -> bool:

@@ -272,6 +272,53 @@ assert digs and all(isinstance(d, str) and len(d) == 64 for d in digs), \
     "control-plane smoke journaled no write-ahead plan digests"
 PYEOF
 
+  # poisoned-driver smoke (ISSUE 16 satellite): the telemetry smoke's
+  # config with value-fault injection live (--poison_rate 0.1 NaN
+  # poison on the deterministic per-round PRNG domain) and in-round
+  # finite screening admitting the poisoned clients out. Gates: the
+  # journal validates (screened event schema), summarize() shows
+  # nonzero screened_total with zero numeric_trips (screening caught
+  # every fault BEFORE the telemetry tripwire), and the final rotated
+  # checkpoint's server weights are finite — poison never reached the
+  # aggregate.
+  JR8=/tmp/_t1_journal_poison.jsonl
+  rm -f "$JR8"
+  rm -rf /tmp/_t1_poison_ckpt
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode uncompressed \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --scan_rounds --scan_span 1 \
+      --poison_rate 0.1 --poison_kind nan --update_screen finite \
+      --checkpoint --checkpoint_every 1 \
+      --checkpoint_path /tmp/_t1_poison_ckpt \
+      --journal_path "$JR8" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "POISON_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR8" \
+      || { echo "POISON_JOURNAL_INVALID"; exit 1; }
+  python - "$JR8" <<'PYEOF' || { echo "POISON_GATE_FAILED"; exit 1; }
+import sys
+import numpy as np
+sys.path.insert(0, ".")
+from commefficient_tpu.telemetry.journal import summarize, validate_journal
+from commefficient_tpu.utils.checkpoint import load_resilient
+records, problems = validate_journal(sys.argv[1])
+assert not problems, problems
+s = summarize(records)
+assert s.get("screened_total", 0) > 0, \
+    "poisoned smoke screened nobody — injection or admission inactive"
+assert s.get("numeric_trips", 0) == 0, \
+    "screening let poison through to the telemetry tripwire"
+loaded = load_resilient("/tmp/_t1_poison_ckpt/ResNet9")
+assert loaded is not None, "poisoned smoke left no loadable checkpoint"
+_, ckpt = loaded
+assert np.isfinite(np.asarray(ckpt.server.ps_weights)).all(), \
+    "non-finite final weights after a screened poisoned run"
+print(f"POISON_GATE_OK screened_total={s['screened_total']}")
+PYEOF
+
   # large-population smoke (ISSUE 9 satellite): the O(active) refactor
   # driven end-to-end at a 100k-client population with the --test tiny
   # model (D=100) and local_topk + local error + momentum + topk_down,

@@ -29,7 +29,7 @@ from commefficient_tpu.config import Config
 from commefficient_tpu.federated.round import (
     PROGRAM_VARIANTS, ROUND_DEAD_ARGNUMS, SPAN_DEAD_ARGNUMS,
     RoundBatch, init_client_state, init_server_state, make_train_fn,
-    program_variant,
+    program_variant, program_variants_for,
 )
 from commefficient_tpu.ops.flat import flatten_params
 
@@ -71,7 +71,9 @@ def test_shipped_baseline_has_no_unjustified_violations():
 def test_audit_covers_programs_and_backends(full_audit):
     report, _ = full_audit
     for cfg_name, _cfg in A.audit_configs():
-        for variant in PROGRAM_VARIANTS:
+        # per-config program family (ISSUE 16): sketch-screened traces
+        # the two screened variants, every other config the defaults
+        for variant in program_variants_for(_cfg):
             assert f"{cfg_name}/{variant}" in report["programs"]
     # the pallas configs really traced pallas kernels (the dispatch
     # gate engaged — otherwise the backend column in PERF.md lies)

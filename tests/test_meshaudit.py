@@ -74,7 +74,9 @@ def test_report_covers_programs_meshes_backends(full_mesh_audit):
                                      "multislice2"}
     for cfg_name, _cfg in M.mesh_configs():
         for mesh_name in report["meshes"]:
-            for program in M.MESH_PROGRAMS:
+            # per-config program family (ISSUE 16): sketch-screened
+            # traces the screened variants plus motion/span
+            for program in M.mesh_programs_for(_cfg):
                 key = f"{cfg_name}/{program}@{mesh_name}"
                 assert key in report["programs"], key
 

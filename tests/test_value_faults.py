@@ -1,0 +1,625 @@
+"""Value-fault-tolerant data plane drills (ISSUE 16).
+
+The tentpole's executable claims:
+
+  * screening OFF is bit-identical: a config that merely turns the
+    screen on (update_screen=finite, nothing poisoned) admits every
+    client and lands the IDENTICAL final server + client state bits
+    as the default config, for sketch / true_topk / fedavg;
+  * a screened client IS a dropped client: scripting the same slots
+    as value-faults-under-screening vs. as dropouts produces
+    bit-identical server state, client state rows, and accounting
+    byte totals, and the journals agree on every round's bytes;
+  * poison -> trip -> rollback -> finite completion, end to end
+    through the real driver (cv_train) on the scanned path, including
+    under --pipeline: exactly one `numeric_trip` journal event, a
+    validating journal, and finite final weights on disk;
+  * a flipped byte in the disk-memmap state tail is caught by the
+    spill-time checksum at restore, quarantined exactly once,
+    journaled as `state_quarantine`, and the run completes finite;
+  * the screened program family stays two compiled programs
+    (screened / screened_stragglers) with per-round poison/screen
+    decisions as data — zero retraces in steady state;
+  * journal readers round-trip the NaN/Infinity/-Infinity sentinels
+    back to floats; the checkpoint manifest's `finite` bit gates
+    load_resilient(require_finite=True) and a missing bit stays
+    loadable (backward compat).
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.valuefaults
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.federated.round import (
+    RoundBatch, program_variant, program_variants_for, screened_family,
+)
+from commefficient_tpu.telemetry import RunJournal, TelemetrySession
+from commefficient_tpu.telemetry.journal import (
+    append_event, read_journal, summarize, validate_journal,
+)
+from commefficient_tpu.training import cv_train
+from commefficient_tpu.utils.checkpoint import (
+    load_resilient, save_rotating,
+)
+from commefficient_tpu.utils.faults import FaultSchedule, poison_mask
+
+D = 8
+W = 8
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+def _problem(seed=0, B=4):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(W, B, D).astype(np.float32)
+    y = rng.randn(W, B).astype(np.float32)
+    return x, y
+
+
+def _fed_model(mode, num_clients=W, **kw):
+    base = dict(mode=mode, grad_size=D, weight_decay=0.0,
+                num_workers=W, local_momentum=0.0, virtual_momentum=0.0,
+                error_type="none", microbatch_size=-1,
+                num_clients=num_clients)
+    base.update(kw)
+    model = FedModel(None, loss_fn, Config(**base).validate(),
+                     params={"w": jnp.zeros(D)},
+                     num_clients=num_clients)
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+def _run_rounds(model, opt, rounds, data):
+    x, y = data
+    ids = np.arange(W, dtype=np.int32)
+    mask = np.ones((W, 4), np.float32)
+    for _ in range(rounds):
+        model((ids, (x, y), mask))
+        opt.step()
+
+
+def _state_arrays(model):
+    return {
+        "ps_weights": np.asarray(model.server.ps_weights),
+        "Vvelocity": np.asarray(model.server.Vvelocity),
+        "Verror": np.asarray(model.server.Verror),
+        "round_idx": np.asarray(model.server.round_idx),
+        "errors": np.asarray(model.clients.errors),
+        "velocities": np.asarray(model.clients.velocities),
+    }
+
+
+# ---------------- screening-off bit-identity ------------------------------
+
+# the three paper modes; true_topk carries local momentum so the
+# per-client (non-fused) backward runs on both sides of the A/B
+SCREEN_MODES = [
+    ("sketch", dict(k=D, num_rows=2, num_cols=64, num_blocks=1,
+                    error_type="virtual", virtual_momentum=0.9)),
+    ("true_topk", dict(k=3, error_type="virtual", local_momentum=0.5)),
+    ("fedavg", dict(local_batch_size=-1, fedavg_batch_size=2,
+                    virtual_momentum=0.9)),
+]
+
+
+@pytest.mark.parametrize("mode,extra", SCREEN_MODES,
+                         ids=[m for m, _ in SCREEN_MODES])
+def test_screening_on_but_inert_bit_identity(mode, extra):
+    """update_screen=finite with nothing poisoned admits every client:
+    final server AND client state are BIT-identical to the default
+    (update_screen=off) run — the screened program's where-based
+    aggregation reproduces the default path's bits exactly."""
+    R = 4
+    data = _problem(seed=7)
+
+    model_a, opt_a = _fed_model(mode, **extra)
+    assert not screened_family(model_a.cfg)
+    _run_rounds(model_a, opt_a, R, data)
+    want = _state_arrays(model_a)
+
+    model_b, opt_b = _fed_model(mode, update_screen="finite", **extra)
+    assert screened_family(model_b.cfg)
+    _run_rounds(model_b, opt_b, R, data)
+    got = _state_arrays(model_b)
+
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"{mode}: {name} diverged with the screen on-but-"
+                    f"inert")
+
+
+def test_norm_screen_inert_bit_identity():
+    """update_screen=norm with well-behaved clients admits everyone
+    too (nobody exceeds screen_norm_mult x the cohort median l2 at
+    the default multiplier on i.i.d. toy data)."""
+    R = 4
+    data = _problem(seed=11)
+    model_a, opt_a = _fed_model("local_topk", k=2, error_type="local",
+                                local_momentum=0.5)
+    _run_rounds(model_a, opt_a, R, data)
+    model_b, opt_b = _fed_model("local_topk", k=2, error_type="local",
+                                local_momentum=0.5, update_screen="norm")
+    _run_rounds(model_b, opt_b, R, data)
+    want, got = _state_arrays(model_a), _state_arrays(model_b)
+    for name in want:
+        np.testing.assert_array_equal(got[name], want[name],
+                                      err_msg=name)
+
+
+# ---------------- screened client == scripted dropout ---------------------
+
+def test_screened_client_matches_scripted_dropout(tmp_path):
+    """The admission contract: poisoning slots {2,5}@r1 and {0}@r3
+    under update_screen=finite lands the IDENTICAL bits — server
+    state, client error/velocity rows, per-round accounting bytes —
+    as scripting the same slots as dropouts, and the two journals
+    agree on every round's byte totals. The screened run additionally
+    journals `screened` events carrying n_screened."""
+    R = 5
+    slots = {1: [2, 5], 3: [0]}
+    data = _problem(seed=9)
+    common = dict(k=2, error_type="local", local_momentum=0.5)
+
+    model_p, opt_p = _fed_model("local_topk", update_screen="finite",
+                                poison_kind="nan", **common)
+    model_p.set_fault_schedule(FaultSchedule(poison=slots))
+    jr_p = str(tmp_path / "poisoned.jsonl")
+    tele_p = TelemetrySession(journal=RunJournal(jr_p))
+    model_p.attach_telemetry(tele_p)
+
+    model_d, opt_d = _fed_model("local_topk", **common)
+    model_d.set_fault_schedule(FaultSchedule(drop_slots=slots))
+    jr_d = str(tmp_path / "dropped.jsonl")
+    tele_d = TelemetrySession(journal=RunJournal(jr_d))
+    model_d.attach_telemetry(tele_d)
+
+    ids = np.arange(W, dtype=np.int32)
+    x, y = data
+    mask = np.ones((W, 4), np.float32)
+    for r in range(R):
+        _, _, down_p, up_p = model_p((ids, (x, y), mask))
+        opt_p.step()
+        _, _, down_d, up_d = model_d((ids, (x, y), mask))
+        opt_d.step()
+        np.testing.assert_array_equal(
+            down_p, down_d, err_msg=f"round {r}: download bytes")
+        np.testing.assert_array_equal(
+            up_p, up_d, err_msg=f"round {r}: upload bytes")
+        for s in slots.get(r, ()):
+            assert up_p[s] == 0.0, \
+                f"round {r}: screened slot {s} still uploaded"
+
+    want, got = _state_arrays(model_d), _state_arrays(model_p)
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"{name}: screened-out != dropped-out")
+
+    tele_p.close(ok=True)
+    tele_d.close(ok=True)
+    recs_p, problems = validate_journal(jr_p)
+    assert not problems, problems
+    recs_d, problems = validate_journal(jr_d)
+    assert not problems, problems
+    rounds_p = {r["round"]: r for r in recs_p if r["event"] == "round"}
+    rounds_d = {r["round"]: r for r in recs_d if r["event"] == "round"}
+    assert set(rounds_p) == set(rounds_d) == set(range(R))
+    for r in range(R):
+        assert rounds_p[r]["down_bytes"] == rounds_d[r]["down_bytes"]
+        assert rounds_p[r]["up_bytes"] == rounds_d[r]["up_bytes"]
+    screened = {r["round"]: r for r in recs_p
+                if r["event"] == "screened"}
+    assert {r: e["n_screened"] for r, e in screened.items()} == \
+        {r: len(s) for r, s in slots.items()}
+    assert all(e["kind"] == "finite" for e in screened.values())
+    assert summarize(recs_p)["screened_total"] == 3
+    assert not any(r["event"] == "screened" for r in recs_d)
+
+
+def test_unscreened_poison_reaches_server():
+    """The injection is real: the same scripted poison WITHOUT the
+    screen (update_screen=off) drives the server weights non-finite —
+    what the rollback drill's trip path detects."""
+    model, opt = _fed_model("local_topk", k=2, error_type="local",
+                            local_momentum=0.5, poison_kind="nan")
+    model.set_fault_schedule(FaultSchedule(poison={1: [3]}))
+    _run_rounds(model, opt, 3, _problem(seed=9))
+    assert not np.isfinite(
+        np.asarray(model.server.ps_weights)).all()
+
+
+def test_poison_scale_caught_by_norm_screen():
+    """poison_kind=scale stays finite (2**40 x), so only the NORM
+    screen catches it — the finite screen alone must let it through,
+    and norm screening must reproduce the dropout bits."""
+    R = 4
+    slots = {1: [4]}
+    data = _problem(seed=13)
+    common = dict(k=2, error_type="local", local_momentum=0.5)
+
+    model_n, opt_n = _fed_model("local_topk", update_screen="norm",
+                                poison_kind="scale", **common)
+    model_n.set_fault_schedule(FaultSchedule(poison=slots))
+    model_d, opt_d = _fed_model("local_topk", **common)
+    model_d.set_fault_schedule(FaultSchedule(drop_slots=slots))
+    _run_rounds(model_n, opt_n, R, data)
+    _run_rounds(model_d, opt_d, R, data)
+    want, got = _state_arrays(model_d), _state_arrays(model_n)
+    for name in want:
+        np.testing.assert_array_equal(got[name], want[name],
+                                      err_msg=name)
+
+    # finite-only screening admits the scaled (finite) garbage
+    model_f, opt_f = _fed_model("local_topk", update_screen="finite",
+                                poison_kind="scale", **common)
+    model_f.set_fault_schedule(FaultSchedule(poison=slots))
+    _run_rounds(model_f, opt_f, R, data)
+    assert not np.array_equal(
+        np.asarray(model_f.server.ps_weights), want["ps_weights"])
+
+
+# ---------------- driver end-to-end: poison -> trip -> rollback -----------
+
+# seed 8 @ rate 0.15 over 8 workers: rounds 0-2 draw nobody, round 3
+# poisons one slot — so span checkpoints r1-r3 are finite before the
+# first corruption lands (the rollback needs a finite frontier to
+# walk back to). The guard test below pins the draw.
+E2E_SEED, E2E_RATE = 8, 0.15
+
+
+def test_e2e_poison_draw_precondition():
+    drawn = [int(poison_mask(E2E_SEED, r, W, E2E_RATE).sum())
+             for r in range(8)]
+    assert drawn[:3] == [0, 0, 0] and drawn[3] > 0, drawn
+
+
+def _run_driver(tmp_path, *extra):
+    argv = [
+        "--test", "--dataset_name", "CIFAR10",
+        "--dataset_dir", str(tmp_path / "ds"),
+        "--local_momentum", "0.0",
+        "--num_workers", "8", "--local_batch_size", "8",
+        "--num_epochs", "0.25", "--valid_batch_size", "16",
+        "--lr_scale", "0.1",
+        *extra,
+    ]
+    return cv_train.main(argv)
+
+
+def _assert_trip_rollback_journal(jr, ck):
+    records, problems = validate_journal(jr)
+    assert not problems, problems
+    trips = [r for r in records if r["event"] == "numeric_trip"]
+    assert len(trips) == 1, \
+        f"expected exactly one numeric_trip, got {len(trips)}"
+    # the trip raises inside the poisoned span BEFORE its boundary
+    # commit, so no non-finite checkpoint ever lands on disk here —
+    # the finite-bit walk-back itself is pinned by
+    # test_manifest_finite_bit_gates_resilient_load
+    assert trips[0]["round"] >= 3  # rounds 0-2 draw no poison
+    # the forced-screen replay admits the poisoned clients out
+    screened = [r for r in records if r["event"] == "screened"]
+    assert screened, "forced screening journaled no screened events"
+    s = summarize(records)
+    assert s["numeric_trips"] == 1
+    assert s["screened_total"] >= len(screened)
+    assert records[-1]["event"] == "run_end"
+    # finite final weights on disk
+    loaded = load_resilient(os.path.join(ck, "ResNet9"))
+    assert loaded is not None
+    _, ckpt = loaded
+    assert np.isfinite(np.asarray(ckpt.server.ps_weights)).all()
+
+
+def test_poison_trip_rollback_completes(tmp_path):
+    """The rollback drill, end to end through cv_train on the scanned
+    path: random NaN poison trips the telemetry watch mid-run, the
+    driver walks back to the newest FINITE span checkpoint, replays
+    with screening forced, and completes — one numeric_trip, a clean
+    journal, finite weights. The resumed stream is BIT-exact in every
+    checkpointed respect: the replayed rounds' selection/admission
+    accounting (survivors, examples, bytes, screened draws) equals a
+    run that screened the identical counter-based poison draws from
+    round 0. (Final weights only agree approximately: the host-side
+    augmentation RNG is process-lifetime state deliberately outside
+    the checkpoint fingerprint, so replayed rounds see later draws.)"""
+    ck = str(tmp_path / "ck")
+    jr = str(tmp_path / "journal.jsonl")
+    assert _run_driver(
+        tmp_path, "--mode", "uncompressed", "--scan_rounds",
+        "--scan_span", "1", "--checkpoint_every", "1",
+        "--ckpt_every_spans", "1", "--keep_checkpoints", "4",
+        "--checkpoint_path", ck, "--journal_path", jr,
+        "--seed", str(E2E_SEED), "--poison_rate", str(E2E_RATE),
+        "--poison_kind", "nan", "--rollback_screen_rounds", "64",
+        "--max_numeric_rollbacks", "3")
+    _assert_trip_rollback_journal(jr, ck)
+
+    # run B: identical config but screened from round 0 — never trips
+    ck2 = str(tmp_path / "ck2")
+    jr2 = str(tmp_path / "journal2.jsonl")
+    assert _run_driver(
+        tmp_path, "--mode", "uncompressed", "--scan_rounds",
+        "--scan_span", "1", "--checkpoint_every", "1",
+        "--ckpt_every_spans", "1", "--keep_checkpoints", "4",
+        "--checkpoint_path", ck2, "--journal_path", jr2,
+        "--seed", str(E2E_SEED), "--poison_rate", str(E2E_RATE),
+        "--poison_kind", "nan", "--update_screen", "finite",
+        "--max_numeric_rollbacks", "3")
+    records2, problems2 = validate_journal(jr2)
+    assert not problems2, problems2
+    assert not any(r["event"] == "numeric_trip" for r in records2), \
+        "always-screened run should never trip"
+
+    # stream bit-exactness from the rolled-back boundary: run A's
+    # post-trip segment must carry the SAME per-round admission
+    # accounting as run B's rounds >= trip round — same screened
+    # draws (counter-based poison PRNG), same survivor counts,
+    # examples and byte totals. These are pure stream facts,
+    # independent of data values.
+    records, _ = validate_journal(jr)
+    trip_idx = next(i for i, r in enumerate(records)
+                    if r["event"] == "numeric_trip")
+    trip_round = records[trip_idx]["round"]
+
+    def stream_facts(recs):
+        rounds = [(r["round"], r["metrics"]["survivors"],
+                   r["metrics"]["examples"], r["down_bytes"],
+                   r["up_bytes"])
+                  for r in recs if r["event"] == "round"
+                  and r["round"] >= trip_round]
+        scr = [(r["round"], r["n_screened"], r["kind"])
+               for r in recs if r["event"] == "screened"
+               and r["round"] >= trip_round]
+        return rounds, scr
+
+    replayed = stream_facts(records[trip_idx + 1:])
+    always = stream_facts(records2)
+    assert replayed == always, (replayed, always)
+    assert replayed[1], "no screened draws in the replayed window"
+
+    # weights agree approximately (the augmentation RNG shift above
+    # bounds this away from bit-equality), and both land finite
+    _, tripped = load_resilient(os.path.join(ck, "ResNet9"))
+    _, screened = load_resilient(os.path.join(ck2, "ResNet9"))
+    a = np.asarray(tripped.server.ps_weights)
+    b = np.asarray(screened.server.ps_weights)
+    assert np.isfinite(b).all()
+    np.testing.assert_allclose(a, b, atol=0.05)
+
+
+@pytest.mark.pipeline
+def test_poison_trip_rollback_completes_pipelined(tmp_path):
+    """The same drill under --pipeline: the trip surfaces from the
+    one-span-late collect with the next span already dispatched and
+    a live prefetch; rollback must drain the writer, discard the
+    stale span, and still complete finite."""
+    ck = str(tmp_path / "ck")
+    jr = str(tmp_path / "journal.jsonl")
+    assert _run_driver(
+        tmp_path, "--mode", "uncompressed", "--scan_rounds",
+        "--scan_span", "1", "--pipeline",
+        "--checkpoint_every", "1", "--ckpt_every_spans", "1",
+        "--keep_checkpoints", "4",
+        "--checkpoint_path", ck, "--journal_path", jr,
+        "--seed", str(E2E_SEED), "--poison_rate", str(E2E_RATE),
+        "--poison_kind", "nan", "--rollback_screen_rounds", "64",
+        "--max_numeric_rollbacks", "3")
+    _assert_trip_rollback_journal(jr, ck)
+
+
+# ---------------- memmap corruption -> quarantine -------------------------
+
+@pytest.mark.statetier
+def test_disk_tail_corruption_quarantined(tmp_path):
+    """Flip bytes in a spilled client's on-disk error row: the next
+    restore's checksum verify quarantines exactly that (client, field)
+    — re-initialized, journaled as `state_quarantine`, healed so later
+    reads do NOT re-fire — and the run completes finite."""
+    POP = 64
+    cfg_kw = dict(k=2, error_type="local", local_momentum=0.5,
+                  state_tier="host", state_working_set=16,
+                  state_spill_dir=str(tmp_path / "tail"))
+    model, opt = _fed_model("local_topk", num_clients=POP, **cfg_kw)
+    jr = str(tmp_path / "journal.jsonl")
+    tele = TelemetrySession(journal=RunJournal(jr))
+    model.attach_telemetry(tele)
+
+    x, y = _problem(seed=3)
+    mask = np.ones((W, 4), np.float32)
+    rng = np.random.RandomState(17)
+    for _ in range(8):
+        ids = rng.choice(POP, W, replace=False).astype(np.int32)
+        model((ids, (x, y), mask))
+        opt.step()
+    store = model.state_store
+    store.flush()
+    assert store.spills > 0 and store.quarantines == 0
+
+    # a checksummed client currently living ONLY in the disk tail
+    victims = [c for c in sorted(store._sums)
+               if c not in store._lru and c not in store._warm]
+    assert victims, "no spilled client to corrupt"
+    cid = victims[0]
+    m = np.lib.format.open_memmap(
+        str(tmp_path / "tail" / "tail_errors.npy"), mode="r+")
+    m[cid] += 1.5  # silent finite corruption — only the CRC sees it
+    m.flush()
+    del m
+
+    ids = np.concatenate([[cid], [c for c in range(POP)
+                                  if c in store._lru][:W - 1]])
+    model((ids.astype(np.int32), (x, y), mask))
+    opt.step()
+    assert store.quarantines == 1
+    # healed: drive the victim through more spill/restore cycles —
+    # the fresh checksum must not re-fire
+    for _ in range(6):
+        ids = rng.choice(POP, W, replace=False).astype(np.int32)
+        ids[0] = cid
+        model((ids, (x, y), mask))
+        opt.step()
+    store.flush()
+    assert store.quarantines == 1
+    assert np.isfinite(np.asarray(model.server.ps_weights)).all()
+
+    model.close_persistence()
+    tele.close(ok=True)
+    records, problems = validate_journal(jr)
+    assert not problems, problems
+    quar = [r for r in records if r["event"] == "state_quarantine"]
+    assert len(quar) == 1
+    assert quar[0]["client"] == cid and quar[0]["field"] == "errors"
+    assert summarize(records)["state_quarantines"] == 1
+
+
+# ---------------- program contracts ---------------------------------------
+
+def test_program_variant_mapping():
+    ids = jnp.arange(W, dtype=jnp.int32)
+    ones = jnp.ones(W)
+    on = jnp.ones(())
+    b = RoundBatch(ids, (jnp.zeros((W, 4, D)), jnp.zeros((W, 4))),
+                   jnp.ones((W, 4)))
+    assert program_variant(b) == "mask_free"
+    assert program_variant(b._replace(survivors=ones)) == "dropout"
+    assert program_variant(b._replace(survivors=ones, work=ones)) == \
+        "dropout_stragglers"
+    assert program_variant(b._replace(
+        survivors=ones, poison=jnp.zeros(W), screen=on)) == "screened"
+    assert program_variant(b._replace(
+        survivors=ones, work=ones, poison=jnp.zeros(W),
+        screen=on)) == "screened_stragglers"
+
+
+def test_program_variants_for_config():
+    base = dict(mode="uncompressed", grad_size=D, num_workers=W,
+                num_clients=W)
+    assert program_variants_for(Config(**base)) == \
+        ("mask_free", "dropout", "dropout_stragglers")
+    assert program_variants_for(Config(update_screen="finite",
+                                       **base)) == \
+        ("screened", "screened_stragglers")
+    assert program_variants_for(Config(poison_rate=0.1, **base)) == \
+        ("screened", "screened_stragglers")
+
+
+def test_screened_program_count_pins(sanitize):
+    """The screened family compiles exactly TWO round programs: the
+    first screened dispatch compiles gather + scatter + screened; a
+    scripted-straggler round adds screened_stragglers; every later
+    round — poison masks flipping, screen decisions changing — is
+    data, never a retrace."""
+    model, opt = _fed_model("local_topk", k=2, error_type="local",
+                            local_momentum=0.5, update_screen="norm",
+                            poison_kind="nan")
+    x, y = _problem(seed=2)
+    ids = np.arange(W, dtype=np.int32)
+    mask = np.ones((W, 4), np.float32)
+
+    with sanitize.assert_program_count(3):
+        model((ids, (x, y), mask))
+        opt.step()
+    model.set_fault_schedule(FaultSchedule(slow={1: {2: 0.5}},
+                                           poison={2: [1]}))
+    with sanitize.assert_program_count(1):  # screened_stragglers
+        model((ids, (x, y), mask))
+        opt.step()
+    with sanitize.assert_program_count(0):  # poison is data
+        for _ in range(3):
+            model((ids, (x, y), mask))
+            opt.step()
+
+
+# ---------------- journal sentinels ---------------------------------------
+
+def test_journal_nonfinite_sentinel_roundtrip(tmp_path):
+    """All three non-finite sentinels survive the write->read round
+    trip as floats again — readers never see the JSON-illegal bare
+    NaN/Infinity tokens, and never see the sentinel STRINGS either."""
+    p = str(tmp_path / "j.jsonl")
+    append_event(p, "round", round=0,
+                 metrics={"update_l2": float("nan"),
+                          "error_l2": float("inf"),
+                          "delta_l2": float("-inf"),
+                          "examples": 32.0})
+    with open(p) as f:
+        raw = f.read()
+    json.loads(raw)  # legal JSON — the sentinels are strings on disk
+    assert '"NaN"' in raw and '"Infinity"' in raw \
+        and '"-Infinity"' in raw
+    records, problems = read_journal(p)
+    assert not problems, problems
+    (rec,) = records
+    m = rec["metrics"]
+    assert np.isnan(m["update_l2"])
+    assert m["error_l2"] == float("inf")
+    assert m["delta_l2"] == float("-inf")
+    assert m["examples"] == 32.0
+
+
+# ---------------- checkpoint finite bit -----------------------------------
+
+def test_manifest_finite_bit_gates_resilient_load(ckpt_dir):
+    """Pos/neg pair: a finite save loads under require_finite; a save
+    that captured NaN state records finite=False and is skipped (with
+    on_fallback fired); stripping the finite map entirely — a pre-16
+    manifest — leaves the newest entry loadable again."""
+    prefix = os.path.join(ckpt_dir, "fin")
+    model, opt = _fed_model("uncompressed", virtual_momentum=0.9)
+    _run_rounds(model, opt, 1, _problem(seed=4))
+    save_rotating(prefix, model.server, model.clients,
+                  fingerprint=model.checkpoint_fingerprint)
+    good_round = int(np.asarray(model.server.round_idx))
+
+    bad_server = model.server._replace(
+        ps_weights=jnp.full(D, jnp.nan, jnp.float32),
+        round_idx=model.server.round_idx + 1)
+    save_rotating(prefix, bad_server, model.clients,
+                  fingerprint=model.checkpoint_fingerprint)
+
+    with open(prefix + ".latest") as f:
+        manifest = json.load(f)
+    assert list(manifest["finite"].values()).count(False) == 1
+
+    fallbacks = []
+    path, ckpt = load_resilient(
+        prefix, expect_fingerprint=model.checkpoint_fingerprint,
+        on_fallback=lambda p, why: fallbacks.append(why),
+        require_finite=True)
+    assert int(np.asarray(ckpt.server.round_idx)) == good_round
+    assert np.isfinite(np.asarray(ckpt.server.ps_weights)).all()
+    assert len(fallbacks) == 1 and "non-finite" in fallbacks[0]
+
+    # without require_finite the newest (non-finite) entry still loads
+    # — plain crash/resume semantics are unchanged
+    path, ckpt = load_resilient(
+        prefix, expect_fingerprint=model.checkpoint_fingerprint)
+    assert int(np.asarray(ckpt.server.round_idx)) == good_round + 1
+
+    # pre-16 manifest (no finite map): unknown-but-loadable
+    manifest.pop("finite")
+    with open(prefix + ".latest", "w") as f:
+        json.dump(manifest, f)
+    path, ckpt = load_resilient(
+        prefix, expect_fingerprint=model.checkpoint_fingerprint,
+        require_finite=True)
+    assert int(np.asarray(ckpt.server.round_idx)) == good_round + 1
