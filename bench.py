@@ -431,6 +431,36 @@ def main() -> int:
         except Exception as e:
             log(f"{td}-table measurement failed: {e}")
 
+    # robust-aggregator sweep (ISSUE 17): the flagship sketch round
+    # with the cross-client reduction swapped for each Byzantine-robust
+    # aggregator. All three arms (including `mean`) run the SCREENED
+    # program family under --update_screen norm with a zeros poison
+    # mask and the screen flag OFF, so the ratios isolate the
+    # order-statistic reduction itself — per-client encoded tables
+    # gathered, ranked, trimmed/medianed — from the admission-mask
+    # plumbing the screened family always carries.
+    aggregator_ms = {}
+    batches_robust = batches._replace(
+        survivors=jnp.ones((ROUNDS, NUM_WORKERS), jnp.float32),
+        poison=jnp.zeros((ROUNDS, NUM_WORKERS), jnp.float32),
+        screen=jnp.zeros((ROUNDS,), jnp.float32))
+    for agg in ("mean", "coord_median", "trimmed_mean"):
+        try:
+            digest_agg = build_digest(cfg.replace(
+                update_screen="norm", aggregator=agg))
+            with alarm_guard(STAGE_TIMEOUT,
+                             f"{agg}-aggregator compile+measure"):
+                float(np.asarray(digest_agg(
+                    server, clients, batches_robust, lrs, key)))
+                aggregator_ms[agg] = median_ms(
+                    digest_agg,
+                    (server, clients, batches_robust, lrs, key),
+                    divisor=ROUNDS)
+        except StageTimeout:
+            log(f"{agg}-aggregator measurement timed out; omitting")
+        except Exception as e:
+            log(f"{agg}-aggregator measurement failed: {e}")
+
     out = {
         "metric": "cifar10_resnet9_sketch_round_time",
         "value": round(round_ms, 3),
@@ -463,6 +493,17 @@ def main() -> int:
         out["vs_xla_backend"] = round(round_ms / pallas_round_ms, 3)
     for td, ms in sorted(table_dtype_ms.items()):
         out[f"value_table_{td}"] = round(ms, 3)
+    for agg, ms in sorted(aggregator_ms.items()):
+        # screened-family arms: value_agg_mean is the apples-to-apples
+        # denominator for the robust ratios (same operands, mean
+        # reduction); vs_mean_<agg> > 1.0 means the order statistics
+        # cost device time over the psum-mean
+        out[f"value_agg_{agg}"] = round(ms, 3)
+    if "mean" in aggregator_ms:
+        for agg, ms in sorted(aggregator_ms.items()):
+            if agg != "mean":
+                out[f"vs_mean_{agg}"] = round(
+                    ms / aggregator_ms["mean"], 3)
     # bytes one client's sketch upload occupies per round at each wire
     # dtype (Config.upload_bytes — the figure the accountant bills):
     # the bytes-on-wire dimension of the sweep, reported even when a

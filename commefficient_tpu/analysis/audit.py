@@ -418,6 +418,18 @@ def audit_configs(backends: Sequence[str] = ("xla", "pallas"),
         local_momentum=0.0, k=g["k"], num_rows=g["rows"],
         num_cols=g["cols"], num_blocks=1, kernel_backend="xla",
         update_screen="norm", **base).validate()))
+    # Byzantine-robust aggregation (ISSUE 17): the screened sketch
+    # config with a live adversary draw and the beta-trimmed mean —
+    # traces the robust reduction (per-client gather, rank
+    # computation, trim mask, residual gauge) riding the screened
+    # program family, so the order-statistic arithmetic is priced and
+    # contract-checked like every other program.
+    out.append(("sketch-robust", Config(
+        mode="sketch", error_type="virtual", virtual_momentum=0.9,
+        local_momentum=0.0, k=g["k"], num_rows=g["rows"],
+        num_cols=g["cols"], num_blocks=1, kernel_backend="xla",
+        update_screen="norm", byzantine_rate=0.2, attack="sign_flip",
+        aggregator="trimmed_mean", **base).validate()))
     return out
 
 

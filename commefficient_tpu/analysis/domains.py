@@ -39,6 +39,7 @@ DOMAINS = {
     "straggler": 0x51044,  # utils/faults.straggler_work_fractions
     "sampler": 0x5C4ED,    # scheduler/policy.ThroughputAwareSampler
     "poison": 0xBAD0D,     # utils/faults.poison_mask (value faults)
+    "byzantine": 0xB42A1,  # utils/faults.byzantine_mask (adversaries)
 }
 
 _values = list(DOMAINS.values())

@@ -21,6 +21,17 @@ ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
 SCREEN_MODES = ("off", "finite", "norm")
 POISON_KINDS = ("nan", "inf", "scale")
+# cross-client reduction of the jitted round (ISSUE 17,
+# federated/round.py): mean is the reference FetchSGD sum/total;
+# the robust tier computes per-cell order statistics over the
+# [num_workers, ...] client update tables inside the round
+AGGREGATORS = ("mean", "coord_median", "trimmed_mean", "norm_clip")
+# scripted adversary kinds (utils/faults "byzantine" PRNG domain):
+# sign_flip/scaled are per-client local corruptions; colluding and
+# little_is_enough are COORDINATED crafted updates built from the
+# honest cohort's statistics — finite and norm-plausible, the class
+# admission screening provably cannot catch
+ATTACKS = ("sign_flip", "scaled", "colluding", "little_is_enough")
 
 # dataset -> num_classes (reference: utils.py:37-44); PERSONA is a
 # language-modeling dataset so has no class count.
@@ -236,6 +247,41 @@ class Config:
     # catches). 0.0 keeps every default program untouched.
     poison_rate: float = 0.0
     poison_kind: str = "nan"
+    # Byzantine-robust aggregation tier (ISSUE 17, federated/round.py
+    # robust programs). aggregator replaces the cross-client mean with
+    # a robust reduction computed INSIDE the jitted round, composed
+    # with the admission mask (screened/dropped clients are excluded
+    # from the order statistics; zero-survivor safe): coord_median is
+    # the per-cell coordinate median over admitted client tables,
+    # trimmed_mean drops the trim_beta fraction from each end of every
+    # cell's order statistics before the FedNova-weighted mean,
+    # norm_clip rescales each client's update to at most the cohort
+    # median l2 before the ordinary weighted mean (the cheap option).
+    # "mean" — the default — keeps the traced round programs
+    # bit-identical to a build without the feature.
+    aggregator: str = "mean"
+    trim_beta: float = 0.2
+    # scripted adversary harness (utils/faults.byzantine_mask, its own
+    # "byzantine" PRNG domain — deterministic in seed+round, same
+    # replay contract as client_dropout/poison). Each sampled client
+    # is an attacker with probability byzantine_rate; `attack` picks
+    # the crafted update (ATTACKS above). 0.0 keeps every default
+    # program untouched.
+    byzantine_rate: float = 0.0
+    attack: str = "sign_flip"
+    # plan-driven adaptive screening (scheduler.AdaptiveScreenController):
+    # with target_screened_rate >= 0 the norm-screen threshold
+    # screen_norm_mult becomes a per-round TRACED operand adjusted
+    # toward the target from the journaled per-round screened-rate —
+    # each adjustment rides the journaled RoundPlan (coordinator-
+    # broadcast under --plan_transport, replayed not recomputed on
+    # takeover) so crash->resume reproduces the exact threshold
+    # trajectory. Negative (the default) keeps the static threshold
+    # and the PR-16 traced programs byte-identical.
+    target_screened_rate: float = -1.0
+    screen_adapt_step: float = 0.5
+    screen_mult_min: float = 1.5
+    screen_mult_max: float = 64.0
     # finite-frontier auto-rollback (the drivers' numeric_trip
     # handler): after a non-finite update/error-l2 trips telemetry and
     # the run rolls back to the newest finite checkpoint, screening is
@@ -548,6 +594,33 @@ class Config:
                 and not self.do_topk_down
                 and self.microbatch_size <= 0)
 
+    @property
+    def robust_aggregation(self) -> bool:
+        """True when the cross-client reduction is a robust order
+        statistic (ISSUE 17). Robust rounds need PER-CLIENT update
+        tables on device, so they always trace the screened program
+        family (the per-client path) even with screening off.
+
+        trimmed_mean with trim_beta == 0.0 trims nothing, so it is
+        statically strength-reduced to the plain mean program: that
+        keeps the inert setting bit-identical to ``--aggregator mean``
+        even under defer_sketch_encode, where the mean path encodes
+        the client SUM once while the robust path must encode every
+        client before the order statistics (a ~1-ULP accumulation-
+        order difference otherwise)."""
+        if self.aggregator == "trimmed_mean" and self.trim_beta == 0.0:
+            return False
+        return self.aggregator != "mean"
+
+    @property
+    def adaptive_screen(self) -> bool:
+        """True when the norm-screen threshold is the plan-carried
+        traced operand the AdaptiveScreenController adjusts (ISSUE
+        17); False keeps the static screen_norm_mult constant folded
+        into the traced programs exactly as PR 16 shipped them."""
+        return (self.target_screened_rate >= 0.0
+                and self.update_screen == "norm")
+
     def resolved_num_clients(self, dataset_num_clients: Optional[int] = None) -> int:
         if self.num_clients is not None:
             return self.num_clients
@@ -636,6 +709,52 @@ class Config:
             raise ValueError(
                 f"unknown poison_kind {self.poison_kind!r} "
                 "(choices: nan, inf, scale — utils/faults)")
+        if self.aggregator not in AGGREGATORS:
+            raise ValueError(
+                f"unknown aggregator {self.aggregator!r} (choices: "
+                f"{', '.join(AGGREGATORS)} — federated/round.py "
+                "robust programs)")
+        if not 0.0 <= self.trim_beta < 0.5:
+            raise ValueError(
+                f"trim_beta={self.trim_beta} must be in [0, 0.5) "
+                "(trimming half the cohort from EACH end leaves no "
+                "client to average)")
+        if not 0.0 <= self.byzantine_rate < 1.0:
+            raise ValueError(
+                f"byzantine_rate={self.byzantine_rate} must be in "
+                "[0, 1) (1.0 leaves no honest client for the robust "
+                "statistics to anchor on)")
+        if self.attack not in ATTACKS:
+            raise ValueError(
+                f"unknown attack {self.attack!r} (choices: "
+                f"{', '.join(ATTACKS)} — utils/faults adversary "
+                "harness)")
+        if self.byzantine_rate > 0 and self.poison_rate > 0:
+            raise ValueError(
+                "--byzantine_rate and --poison_rate are mutually "
+                "exclusive: both ride the per-client fault operand, "
+                "and a slot cannot be simultaneously an accidental "
+                "value fault and a scripted adversary")
+        if self.target_screened_rate >= 0:
+            if self.update_screen != "norm":
+                raise ValueError(
+                    "--target_screened_rate adapts the NORM-screen "
+                    "threshold and requires --update_screen norm "
+                    "(finite screening has no threshold to adapt)")
+            if self.target_screened_rate >= 1.0:
+                raise ValueError(
+                    f"target_screened_rate={self.target_screened_rate}"
+                    " must be < 1 (screening the whole cohort every "
+                    "round is a dead run)")
+        if self.screen_adapt_step <= 0:
+            raise ValueError(
+                "screen_adapt_step must be > 0 (the multiplicative "
+                "adjustment factor is 1 + step)")
+        if not 1.0 < self.screen_mult_min <= self.screen_mult_max:
+            raise ValueError(
+                f"need 1 < screen_mult_min={self.screen_mult_min} <= "
+                f"screen_mult_max={self.screen_mult_max} (same > 1 "
+                "floor as screen_norm_mult)")
         if self.rollback_screen_rounds < 1:
             raise ValueError(
                 "rollback_screen_rounds must be >= 1: a rollback that "
@@ -992,6 +1111,51 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
                         "update: nan/inf overwrite it, scale "
                         "multiplies by 2^40 (finite explosion — only "
                         "the norm screen catches it)")
+    p.add_argument("--aggregator", choices=list(AGGREGATORS),
+                   default="mean",
+                   help="cross-client reduction inside the jitted "
+                        "round (ISSUE 17): mean (default, reference "
+                        "FetchSGD sum), coord_median / trimmed_mean "
+                        "(per-cell order statistics over admitted "
+                        "client tables), norm_clip (clip each client "
+                        "to the cohort median l2 before the weighted "
+                        "mean)")
+    p.add_argument("--trim_beta", type=float, default=0.2,
+                   help="trimmed_mean: fraction of admitted clients "
+                        "trimmed from EACH end of every cell's order "
+                        "statistics (Config.trim_beta)")
+    p.add_argument("--byzantine_rate", type=float, default=0.0,
+                   help="scripted adversary harness: per-round "
+                        "probability a sampled client is an attacker "
+                        "(deterministic in seed+round on its own "
+                        "'byzantine' PRNG domain; "
+                        "utils/faults.byzantine_mask)")
+    p.add_argument("--attack", choices=list(ATTACKS),
+                   default="sign_flip",
+                   help="crafted update an attacker submits: "
+                        "sign_flip/scaled are local corruptions; "
+                        "colluding and little_is_enough are "
+                        "coordinated, finite, norm-plausible updates "
+                        "built from the honest cohort's statistics — "
+                        "the class admission screening cannot catch")
+    p.add_argument("--target_screened_rate", type=float, default=-1.0,
+                   help="adaptive screening: adjust the norm-screen "
+                        "threshold toward this per-round screened "
+                        "fraction, every adjustment riding the "
+                        "journaled RoundPlan (negative = off, static "
+                        "--screen_norm_mult; requires --update_screen "
+                        "norm; scheduler.AdaptiveScreenController)")
+    p.add_argument("--screen_adapt_step", type=float, default=0.5,
+                   help="adaptive screening multiplicative step: an "
+                        "adjustment scales the threshold by "
+                        "(1 + step) up or down "
+                        "(Config.screen_adapt_step)")
+    p.add_argument("--screen_mult_min", type=float, default=1.5,
+                   help="adaptive screening threshold floor "
+                        "(Config.screen_mult_min)")
+    p.add_argument("--screen_mult_max", type=float, default=64.0,
+                   help="adaptive screening threshold ceiling "
+                        "(Config.screen_mult_max)")
     p.add_argument("--rollback_screen_rounds", type=int, default=8,
                    help="after a numeric_trip rollback, force update "
                         "screening on for this many rounds so the "

@@ -221,6 +221,14 @@ class CommAccountant:
         and its staleness keeps growing — it will pay the accumulated
         download the next round it actually finishes.
 
+        ISSUE 17 narrows what the caller passes here: under value
+        screening the mask is the ADMITTED set (screened == dropped ==
+        not billed), and under a robust aggregator it is the
+        CONTRIBUTOR set — a client every one of whose cells was
+        trimmed out of the order statistics contributed nothing to the
+        aggregate and is not billed upload bytes either. The mask
+        producer changed; this method's contract did not.
+
         Returns (download_bytes, upload_bytes), each [W] COHORT-indexed
         — aligned slot-for-slot with `participating`, dropped slots
         charged 0.0. (Before ISSUE 9 these were [num_clients] vectors:

@@ -54,8 +54,8 @@ from commefficient_tpu.parallel.plantransport import (
 from commefficient_tpu.telemetry.clients import ClientThroughputTracker
 from commefficient_tpu.telemetry.trace import TRACE
 from commefficient_tpu.utils.faults import (
-    FaultSchedule, InjectedFault, bernoulli_survivors, poison_mask,
-    straggler_work_fractions,
+    FaultSchedule, InjectedFault, bernoulli_survivors, byzantine_mask,
+    poison_mask, straggler_work_fractions,
 )
 from commefficient_tpu.utils.retry import is_transient_error, with_retries
 
@@ -255,6 +255,19 @@ class FedModel:
         # force_screen_rounds after a numeric-trip rollback so the
         # replayed window screens the corruption out. 0 = no window.
         self._screen_force_until = 0
+        # adaptive screening (ISSUE 17): one controller per run tunes
+        # the norm-screen multiplier toward --target_screened_rate;
+        # attach_scheduler shares it with the RoundScheduler so the
+        # live value rides every sealed plan. _plan_screen_mult stashes
+        # a consumed plan's stamped multiplier per round — a replayed
+        # or broadcast plan's value WINS over the local controller's.
+        self.screen_ctl = None
+        if cfg.adaptive_screen:
+            from commefficient_tpu.scheduler import (
+                AdaptiveScreenController,
+            )
+            self.screen_ctl = AdaptiveScreenController(cfg)
+        self._plan_screen_mult = {}
         # observability (telemetry/): the throughput tracker always
         # exists (cheap arrays; its state rides in every checkpoint so
         # resume restores it even for runs that never journal), while
@@ -347,6 +360,11 @@ class FedModel:
             scheduler.state_prefetch = (
                 self.state_store.prefetch_host_rows
                 if self.state_store is not None else None)
+            # adaptive screening (ISSUE 17): the scheduler stamps the
+            # controller's live multiplier into every sealed plan (and
+            # its is_default goes False, so plans exist to carry it)
+            if self.screen_ctl is not None:
+                scheduler.screen_ctl = self.screen_ctl
 
     def scheduler_state(self) -> Optional[dict]:
         """The `sched_*` checkpoint payload: the attached scheduler's
@@ -736,6 +754,12 @@ class FedModel:
             if plan.work is not None:
                 w = np.asarray(plan.work, np.float32)
                 work = w if work is None else np.minimum(work, w)
+            if plan.screen_mult is not None:
+                # adaptive screening (ISSUE 17): a replayed/broadcast
+                # plan's stamped multiplier wins over the local
+                # controller's value (_screen_flag pops this)
+                self._plan_screen_mult[int(round_idx)] = float(
+                    plan.screen_mult)
             # journaling is deferred to _seal_plan (ISSUE 12): the
             # `schedule` event must carry the digest of the FULLY
             # composed decision (async admits land after this pass)
@@ -781,7 +805,8 @@ class FedModel:
         return (fround.screened_family(self.cfg)
                 or round_idx < self._screen_force_until
                 or (self.fault_schedule is not None
-                    and bool(self.fault_schedule.poison)))
+                    and bool(self.fault_schedule.poison
+                             or self.fault_schedule.byzantine)))
 
     def _poison_values(self, round_idx: int,
                        num_slots: int) -> np.ndarray:
@@ -790,7 +815,23 @@ class FedModel:
         own PRNG domain — deterministic in (seed, round), so a resumed
         run replays the identical faults) max-composed with any
         scripted FaultSchedule.poison slots. All-zeros when nothing
-        poisons — the inert operand a screening-only round ships."""
+        poisons — the inert operand a screening-only round ships.
+
+        Byzantine adversaries (ISSUE 17) ride the SAME operand: under
+        Config.byzantine_rate > 0 (validate() makes the two rates
+        mutually exclusive, and the attack transform keys statically
+        off the rate) the flags mark adversary-controlled slots
+        instead — drawn on the "byzantine" PRNG domain, max-composed
+        with scripted FaultSchedule.byzantine slots."""
+        if self.cfg.byzantine_rate > 0:
+            mask = byzantine_mask(self.cfg.seed, round_idx, num_slots,
+                                  self.cfg.byzantine_rate)
+            if self.fault_schedule is not None:
+                scripted = self.fault_schedule.byzantine_mask_for(
+                    round_idx, num_slots)
+                if scripted is not None:
+                    mask = np.maximum(mask, scripted)
+            return mask
         mask = poison_mask(self.cfg.seed, round_idx, num_slots,
                            self.cfg.poison_rate)
         if self.fault_schedule is not None:
@@ -801,13 +842,31 @@ class FedModel:
         return mask
 
     def _screen_flag(self, round_idx: int) -> np.float32:
-        """The traced screen-enable scalar for one round: 1.0 when the
-        admission screen applies (configured on, or the round is in a
-        forced post-rollback window), else 0.0 — poison then flows
-        through to the server state (the trip path)."""
+        """The traced screen-enable scalar for one round: nonzero when
+        the admission screen applies (configured on, or the round is
+        in a forced post-rollback window), else 0.0 — poison then
+        flows through to the server state (the trip path).
+
+        Adaptive screening (ISSUE 17): under Config.adaptive_screen
+        the scalar's VALUE is the live norm multiplier — the traced
+        program never changes, the threshold is data. screen_mult_min
+        > 1 keeps every on-value disjoint from the off sentinel 0. A
+        consumed plan's stamped multiplier (broadcast or journal
+        replay — _faults_for_round stashed it) wins over the local
+        controller's, so takeover and restart REPLAY the trajectory
+        instead of recomputing it."""
         on = (self.cfg.update_screen != "off"
               or round_idx < self._screen_force_until)
-        return np.float32(1.0 if on else 0.0)
+        if not on:
+            self._plan_screen_mult.pop(int(round_idx), None)
+            return np.float32(0.0)
+        if self.cfg.adaptive_screen:
+            mult = self._plan_screen_mult.pop(int(round_idx), None)
+            if mult is None and self.screen_ctl is not None:
+                mult = self.screen_ctl.plan_mult()
+            if mult is not None:
+                return np.float32(mult)
+        return np.float32(1.0)
 
     def force_screen_rounds(self, n: int) -> None:
         """Force the in-round admission screen ON for the next `n`
@@ -818,6 +877,44 @@ class FedModel:
         out, so the run crosses the trip boundary finitely."""
         self._screen_force_until = max(
             self._screen_force_until, self._rounds_done + int(n))
+
+    # -- robust aggregation + adaptive screening (ISSUE 17) ---------------
+    def _journal_aggregator(self, round_idx: int,
+                            stats: np.ndarray) -> None:
+        """Journal one round's `aggregator` event from the device
+        agg_stats vector (round.RoundMetrics.agg_stats): mean clients
+        trimmed per cell, clients norm-clipped, the l2 residual
+        between the robust aggregate and the admitted mean, and the
+        contributing-client count. A non-finite residual (an entirely
+        corrupt cohort) journals as -1.0 — the journal is strict
+        JSON."""
+        resid = float(stats[2])
+        self.telemetry.journal_event(
+            "aggregator", round=int(round_idx),
+            aggregator=self.cfg.aggregator,
+            n_trimmed=round(float(stats[0]), 6),
+            n_clipped=int(stats[1]),
+            residual_l2=(round(resid, 6) if np.isfinite(resid)
+                         else -1.0),
+            n_contrib=int(stats[3]))
+
+    def _observe_screening(self, round_idx: int, n_screened: int,
+                           survivors) -> None:
+        """Feed the adaptive-screen controller one committed round's
+        observed screened count — EVERY round, zero included, so the
+        trajectory is a pure function of the observation stream — and
+        journal a `screen_adapt` event when the threshold moved."""
+        n_cohort = (int((np.asarray(survivors) > 0).sum())
+                    if survivors is not None else 0)
+        changed = self.screen_ctl.observe(round_idx, n_screened,
+                                          n_cohort)
+        if changed is not None and self.telemetry is not None:
+            old, new, rate = changed
+            self.telemetry.journal_event(
+                "screen_adapt", round=int(round_idx),
+                old_mult=round(old, 6), new_mult=round(new, 6),
+                rate=round(rate, 6),
+                target=float(self.cfg.target_screened_rate))
 
     # -- reference API surface -------------------------------------------
     def train(self, training: bool):
@@ -1177,27 +1274,43 @@ class FedModel:
             # a screened client is billed exactly like a dropped one.
             # The device_get is a sync, but only screened configs ever
             # take it; the default path reads the host copy as before.
+            # Robust aggregation (ISSUE 17) narrows the billed mask
+            # once more: a client the order statistics kept NO cell of
+            # (metrics.contributors) shipped an update the aggregate
+            # provably contains nothing of, so it is billed like a
+            # screened one.
             surv_acc = staged.survivors
             if metrics.admitted is not None:
                 surv_acc = np.asarray(jax.device_get(metrics.admitted),
                                       np.float32)
+            surv_bill = surv_acc
+            if metrics.contributors is not None:
+                surv_bill = np.asarray(
+                    jax.device_get(metrics.contributors), np.float32)
             download, upload = self.accountant.record_round(
                 staged.client_ids,
                 None if self._prev_change_words is None
                 else np.asarray(self._prev_change_words),
-                survivors=surv_acc)
+                survivors=surv_bill)
         self._prev_change_words = bits
-        if (metrics.admitted is not None and staged.survivors is not None
-                and self.telemetry is not None):
+        n_screened = None
+        if metrics.admitted is not None and staged.survivors is not None:
             n_screened = int((staged.survivors > 0).sum()
                              - (surv_acc > 0).sum())
-            if n_screened > 0:
+            if n_screened > 0 and self.telemetry is not None:
                 self.telemetry.journal_event(
                     "screened", round=this_round,
                     n_screened=n_screened,
                     kind=(self.cfg.update_screen
                           if self.cfg.update_screen != "off"
                           else "finite"))
+        if metrics.agg_stats is not None and self.telemetry is not None:
+            self._journal_aggregator(
+                this_round, np.asarray(
+                    jax.device_get(metrics.agg_stats), np.float64))
+        if self.screen_ctl is not None and n_screened is not None:
+            self._observe_screening(this_round, n_screened,
+                                    staged.survivors)
 
         # telemetry, one-round lag (same discipline as the metric
         # return below): hand the session this round's DEVICE metric
@@ -1591,22 +1704,43 @@ class FedModel:
             if metrics.admitted is not None:
                 admitted_rows = np.asarray(
                     mh.gather_host(metrics.admitted), np.float32)
+            # robust aggregation (ISSUE 17): per-round contributor
+            # masks (billing) and aggregator stats (journal) ride the
+            # span results like the admitted rows — the bits transfer
+            # already forced the span, these gathers add no sync
+            contrib_rows = None
+            if metrics.contributors is not None:
+                contrib_rows = np.asarray(
+                    mh.gather_host(metrics.contributors), np.float32)
+            agg_rows = None
+            if metrics.agg_stats is not None:
+                agg_rows = np.asarray(
+                    mh.gather_host(metrics.agg_stats), np.float64)
             comm_rows = []
             for n in range(ids_host.shape[0]):
                 surv_n = None if surv_all is None else surv_all[n]
                 if admitted_rows is not None:
-                    if (self.telemetry is not None
-                            and surv_n is not None):
+                    n_scr = None
+                    if surv_n is not None:
                         n_scr = int((surv_n > 0).sum()
                                     - (admitted_rows[n] > 0).sum())
-                        if n_scr > 0:
+                        if n_scr > 0 and self.telemetry is not None:
                             self.telemetry.journal_event(
                                 "screened", round=first + n,
                                 n_screened=n_scr,
                                 kind=(self.cfg.update_screen
                                       if self.cfg.update_screen
                                       != "off" else "finite"))
-                    surv_n = admitted_rows[n]
+                    if (agg_rows is not None
+                            and self.telemetry is not None):
+                        self._journal_aggregator(first + n,
+                                                 agg_rows[n])
+                    if self.screen_ctl is not None and n_scr is not None:
+                        self._observe_screening(first + n, n_scr,
+                                                surv_n)
+                    surv_n = (contrib_rows[n]
+                              if contrib_rows is not None
+                              else admitted_rows[n])
                 if account:
                     d, u = self.accountant.record_round(
                         ids_host[n], self._prev_change_words,

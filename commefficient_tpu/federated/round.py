@@ -179,12 +179,29 @@ class RoundMetrics(NamedTuple):
     no new leaves — everywhere else). host survivors x device admit:
     the mask accounting and the journal must see so a screened client
     is charged exactly like a dropped one (federated/api reads it
-    back at commit/collect time)."""
+    back at commit/collect time).
+
+    contributors (ISSUE 17, robust aggregators only): the subset of
+    `admitted` whose values actually reached the robust aggregate —
+    a client β-trimmed out of EVERY cell is admitted but contributes
+    nothing, and the accountant must not bill upload bytes for it
+    (screened==dropped bit-exactness extended to bytes). Identical
+    to `admitted` for coord_median/norm_clip (every admitted client
+    is order-statistic / clipped-sum material).
+
+    agg_stats (robust aggregators only): [4] f32 —
+    (clients trimmed per cell on average, clients norm-clipped,
+    l2 residual of robust-vs-mean aggregate, contributing clients) —
+    the per-round `aggregator` journal event's payload; the residual
+    is the attack-severity gauge (large when the mean is being
+    dragged somewhere the order statistics refuse to follow)."""
     losses: jax.Array            # [num_workers] per-client mean loss
     metrics: Tuple[jax.Array, ...]  # per-client means, each [num_workers]
     num_examples: jax.Array      # [num_workers]
     telemetry: jax.Array = None  # [telemetry.metrics.NUM_METRICS] or [0]
     admitted: Optional[jax.Array] = None  # [num_workers] f32 or None
+    contributors: Optional[jax.Array] = None  # [num_workers] f32 or None
+    agg_stats: Optional[jax.Array] = None     # [4] f32 or None
 
 
 def init_server_state(cfg: Config, ps_weights: jax.Array,
@@ -307,7 +324,14 @@ PROGRAM_VARIANTS = ("mask_free", "dropout", "dropout_stragglers")
 # exist — screened, and screened+stragglers — and the per-round
 # decision "does the admission screen apply" is data, never a
 # retrace. Default configs never build this treedef, keeping the
-# three programs above byte-identical.
+# three programs above byte-identical. ISSUE 17 extends the family
+# (same two variant NAMES, config-keyed program bodies): byzantine
+# adversaries ride the poison operand with an ATTACK transform
+# instead of a corruption kind, robust aggregators replace the
+# psum-mean tail with in-round order statistics over the gathered
+# client tables, and under adaptive screening the screen scalar's
+# VALUE is the live norm multiplier — all static config branches, so
+# a PR-16 screened config still traces its exact pre-17 programs.
 SCREENED_PROGRAM_VARIANTS = ("screened", "screened_stragglers")
 
 # multiplier applied by the "scale" poison kind: large enough that a
@@ -339,12 +363,16 @@ SPAN_DEAD_ARGNUMS = (0, 1)
 
 def screened_family(cfg: Config) -> bool:
     """Whether `cfg` steady-state dispatches the SCREENED program
-    family (in-round admission and/or value-fault injection
-    configured). A default config can still dispatch screened
-    programs transiently — the finite-frontier rollback force-enables
-    screening for a bounded window — but its audited steady-state
-    program set is the three defaults."""
-    return cfg.update_screen != "off" or cfg.poison_rate > 0
+    family (in-round admission, value-fault injection, byzantine
+    adversaries, or a robust aggregator configured — the latter two
+    because attacks ride the poison operand and the robust reductions
+    need the per-client transmits plus the admission mask, so both
+    always take the per-client screened path). A default config can
+    still dispatch screened programs transiently — the finite-frontier
+    rollback force-enables screening for a bounded window — but its
+    audited steady-state program set is the three defaults."""
+    return (cfg.update_screen != "off" or cfg.poison_rate > 0
+            or cfg.byzantine_rate > 0 or cfg.robust_aggregation)
 
 
 def program_variants_for(cfg: Config) -> tuple:
@@ -576,12 +604,16 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                 results, new_w_rows = jax.vmap(one_client)(
                     data, mask, err_rows, vel_rows, w_rows, keys)
             if pois is not None:
-                # ---- screened family (ISSUE 16) ----
-                # value-fault injection first: corrupt flagged
-                # clients' transmits. With an all-zero mask every
-                # `where` passes the clean value through bit-exactly,
-                # so a screened run without live poison computes the
-                # identical wire values.
+                # ---- screened family (ISSUE 16 / ISSUE 17) ----
+                # fault injection first: corrupt flagged clients'
+                # transmits. With an all-zero mask every `where`
+                # passes the clean value through bit-exactly, so a
+                # screened run without live poison computes the
+                # identical wire values. Under Config.byzantine_rate
+                # the SAME operand carries adversary flags instead
+                # (validate() keeps the two mutually exclusive) and
+                # the transform is the scripted ATTACK — a static
+                # branch, so PR-16 screened programs are untouched.
                 def corrupt(t):
                     flag = pois.reshape(
                         pois.shape + (1,) * (t.ndim - 1)) > 0
@@ -592,7 +624,92 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                     bad = (jnp.inf if cfg.poison_kind == "inf"
                            else jnp.nan)
                     return jnp.where(flag, jnp.asarray(bad, t.dtype), t)
-                tx = jax.tree.map(corrupt, results.transmit)
+
+                def attack(trans):
+                    """Byzantine adversary transform (ISSUE 17):
+                    flagged clients REPLACE their transmit per
+                    Config.attack. sign_flip/scaled are per-client
+                    (gradient reversal / magnitude domination — both
+                    caught by a norm screen); colluding submits ONE
+                    coordinated crafted update — the negated honest
+                    mean direction at a 0.9 margin UNDER the norm
+                    screen's admission threshold (mult x cohort
+                    median; high-norm attackers can only push the
+                    cohort median above the honest median, so
+                    0.9*mult*med_honest <= mult*med_cohort and the
+                    screen provably admits it): finite, norm-
+                    plausible, and maximally damaging — the class
+                    admission screening provably cannot catch, the
+                    negative control that justifies the robust
+                    aggregators. little_is_
+                    enough stays inside one honest standard deviation
+                    per coordinate (Baruch et al.) — mild per-cell,
+                    damaging in aggregate. The honest-cohort stats
+                    are computed over the all_gathered per-client
+                    tables, so every shard crafts the identical
+                    update."""
+                    leaves, treedef = jax.tree.flatten(trans)
+                    W = leaves[0].shape[0]
+                    V = jnp.concatenate(
+                        [t.reshape(W, -1).astype(jnp.float32)
+                         for t in leaves], axis=1)
+                    if cfg.attack == "sign_flip":
+                        A = -V
+                    elif cfg.attack == "scaled":
+                        A = V * jnp.float32(100.0)
+                    else:
+                        allV = jax.lax.all_gather(
+                            V, "clients").reshape(-1, V.shape[1])
+                        allF = jax.lax.all_gather(
+                            pois, "clients").reshape(-1) > 0
+                        allS = jax.lax.all_gather(
+                            surv, "clients").reshape(-1) > 0
+                        honest = ((~allF) & allS
+                                  & jnp.isfinite(allV).all(axis=1))
+                        nh = jnp.maximum(honest.sum(), 1)
+                        hmean = jnp.where(
+                            honest[:, None], allV, 0.0).sum(0) / nh
+                        if cfg.attack == "little_is_enough":
+                            hvar = jnp.where(
+                                honest[:, None],
+                                jnp.square(allV - hmean[None, :]),
+                                0.0).sum(0) / nh
+                            crafted = hmean - jnp.sqrt(hvar)
+                        else:  # colluding
+                            hnorm = jnp.sqrt(jnp.square(allV).sum(1))
+                            med = jnp.nanmedian(
+                                jnp.where(honest, hnorm, jnp.nan))
+                            med = jnp.where(honest.sum() > 0, med,
+                                            jnp.float32(1.0))
+                            # the admission envelope the adversary
+                            # provably fits under (the screen's own
+                            # mult expression; >= 1 keeps the attack
+                            # meaningful when screening is off)
+                            amult = jnp.maximum(
+                                (screen if cfg.adaptive_screen
+                                 else jnp.float32(
+                                     cfg.screen_norm_mult)),
+                                jnp.float32(1.0))
+                            d = -hmean
+                            crafted = d * (
+                                jnp.float32(0.9) * amult * med
+                                / jnp.maximum(
+                                    jnp.sqrt(jnp.square(d).sum()),
+                                    jnp.float32(1e-12)))
+                        A = jnp.broadcast_to(crafted[None, :], V.shape)
+                    out_flat = jnp.where(pois[:, None] > 0, A, V)
+                    outs, off = [], 0
+                    for t in leaves:
+                        n = t[0].size
+                        outs.append(out_flat[:, off:off + n].reshape(
+                            t.shape).astype(t.dtype))
+                        off += n
+                    return jax.tree.unflatten(treedef, outs)
+
+                if cfg.byzantine_rate > 0:
+                    tx = attack(results.transmit)
+                else:
+                    tx = jax.tree.map(corrupt, results.transmit)
 
                 # admission screen: per-client finite bit over every
                 # transmit leaf ...
@@ -624,9 +741,16 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                             & (all_l2 > 0))
                     med = jnp.nanmedian(
                         jnp.where(elig, all_l2, jnp.nan))
+                    # adaptive screening (ISSUE 17): the screen
+                    # operand's VALUE is the live norm multiplier —
+                    # the AdaptiveScreenController's plan-journaled
+                    # adjustments reach the traced program as data,
+                    # never a retrace. Static branch: non-adaptive
+                    # configs trace the exact PR-16 constant.
+                    mult = (screen if cfg.adaptive_screen
+                            else cfg.screen_norm_mult)
                     norm_ok = jnp.where(
-                        elig.sum() > 0,
-                        l2 <= cfg.screen_norm_mult * med, True)
+                        elig.sum() > 0, l2 <= mult * med, True)
                     ok = ok & norm_ok
                 # the traced enable flag: screen off -> admit mask
                 # computed but not applied (corruption flows through
@@ -634,18 +758,132 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                 admit = jnp.where(screen > 0,
                                   ok.astype(jnp.float32), 1.0)
                 surv_eff = surv * admit
-                # `where`, NOT multiplication: a poisoned excluded
-                # client's NaN/Inf must become an exact zero in the
-                # local sum (NaN * 0 is NaN) — this is also what makes
-                # a screened client bit-identical to a dropped one
-                local_sum = jax.tree.map(
-                    lambda t: jnp.where(
-                        surv_eff.reshape(
-                            surv_eff.shape + (1,) * (t.ndim - 1)) > 0,
-                        t, jnp.zeros_like(t)).sum(axis=0),
-                    tx)
                 counts = results.num_examples * surv_eff
                 admitted = surv_eff
+                if cfg.robust_aggregation:
+                    # ---- robust cross-client reduction (ISSUE 17) --
+                    # Order statistics over the gathered per-client
+                    # tables replace the psum-mean: per-cell
+                    # coordinate-median / β-trimmed-mean, or
+                    # norm-clipping-to-cohort-median. Computed in
+                    # AGGREGATION SPACE — in sketch mode each client's
+                    # transmit is encoded (and wire-quantized)
+                    # individually first, so the reduction runs over
+                    # [N, r, c] sketch tables exactly as FetchSGD's
+                    # linearity suggests; the deferred shard-sum
+                    # encode below is bypassed (an order statistic
+                    # does not distribute over the sum). Screened or
+                    # dropped clients are excluded per cell via
+                    # `where` masks (zero-survivor safe, NaN-safe);
+                    # ranks are taken on the per-client MEAN updates
+                    # (example weights normalize out) while the kept
+                    # aggregate stays example-weighted, preserving
+                    # the FedNova work-reweighting. (trimmed_mean
+                    # with trim_beta == 0.0 never reaches this block:
+                    # Config.robust_aggregation strength-reduces it
+                    # to the plain mean program, which is the only
+                    # way to stay bit-identical under the deferred
+                    # shard-sum encode below.)
+                    txa = tx
+                    if cfg.defer_sketch_encode:
+                        txa = jax.vmap(
+                            fserver.args2sketch(cfg).encode)(txa)
+                    if (cfg.mode == "sketch"
+                            and cfg.sketch_table_dtype != "f32"):
+                        from commefficient_tpu.ops.kernels import (
+                            wire_roundtrip,
+                        )
+                        txa = wire_roundtrip(txa,
+                                             cfg.sketch_table_dtype)
+                    leaves_a, treedef_a = jax.tree.flatten(txa)
+                    Wl = leaves_a[0].shape[0]
+                    V = jnp.concatenate(
+                        [t.reshape(Wl, -1).astype(jnp.float32)
+                         for t in leaves_a], axis=1)
+                    allV = jax.lax.all_gather(
+                        V, "clients").reshape(-1, V.shape[1])
+                    n_w = jax.lax.all_gather(
+                        counts, "clients").reshape(-1)
+                    adm = jax.lax.all_gather(
+                        surv_eff, "clients").reshape(-1) > 0
+                    # per-cell eligibility: admitted AND finite (a
+                    # screen-off round may admit NaN/Inf transmits;
+                    # order statistics must stay well-defined)
+                    E = adm[:, None] & jnp.isfinite(allV)
+                    wcol = n_w[:, None]
+                    total_w = n_w.sum()
+                    # per-client mean updates: the rank/norm material
+                    U = allV / jnp.maximum(n_w, 1.0)[:, None]
+                    mean_agg = (jnp.where(E, allV, 0.0).sum(0)
+                                / jnp.maximum(total_w, 1.0))
+                    n_trim = n_clip = jnp.float32(0.0)
+                    keep = E
+                    if cfg.aggregator == "coord_median":
+                        med = jnp.nanmedian(
+                            jnp.where(E, U, jnp.nan), axis=0)
+                        agg = jnp.where(E.any(axis=0), med, 0.0)
+                    elif cfg.aggregator == "trimmed_mean":
+                        vals = jnp.where(E, U, jnp.inf)
+                        order = jnp.argsort(vals, axis=0)
+                        ranks = jnp.argsort(order, axis=0)
+                        n_e = E.sum(axis=0)
+                        # trim floor(β·n_e) per side, clamped so at
+                        # least one value survives per nonempty cell
+                        m = jnp.minimum(
+                            jnp.floor(cfg.trim_beta
+                                      * n_e).astype(jnp.int32),
+                            jnp.maximum(n_e - 1, 0) // 2)
+                        keep = (E & (ranks >= m[None, :])
+                                & (ranks < (n_e - m)[None, :]))
+                        ksum = jnp.where(keep, wcol, 0.0).sum(0)
+                        agg = (jnp.where(keep, allV, 0.0).sum(0)
+                               / jnp.maximum(ksum, 1.0))
+                        n_trim = (jnp.where(E & ~keep, 1.0, 0.0).sum()
+                                  / jnp.float32(V.shape[1]))
+                    else:  # norm_clip
+                        l2u = jnp.sqrt(
+                            jnp.where(E, jnp.square(U), 0.0).sum(1))
+                        elign = adm & (l2u > 0) & jnp.isfinite(l2u)
+                        medn = jnp.nanmedian(
+                            jnp.where(elign, l2u, jnp.nan))
+                        clip = jnp.where(
+                            elign & (l2u > medn),
+                            medn / jnp.maximum(l2u,
+                                               jnp.float32(1e-30)),
+                            jnp.float32(1.0))
+                        n_clip = (clip < 1.0).sum().astype(jnp.float32)
+                        agg = (jnp.where(E, allV * clip[:, None],
+                                         0.0).sum(0)
+                               / jnp.maximum(total_w, 1.0))
+                    resid = jnp.sqrt(jnp.square(agg - mean_agg).sum())
+                    contrib_all = (adm & keep.any(axis=1)).astype(
+                        jnp.float32)
+                    contrib = jax.lax.dynamic_slice_in_dim(
+                        contrib_all,
+                        jax.lax.axis_index("clients") * Wl, Wl)
+                    agg_stats = jnp.stack(
+                        [n_trim, n_clip, resid, contrib_all.sum()])
+                    outs, off = [], 0
+                    for t in leaves_a:
+                        n = t[0].size
+                        outs.append(agg[off:off + n].reshape(
+                            t.shape[1:]).astype(t.dtype))
+                        off += n
+                    robust_tx = jax.tree.unflatten(treedef_a, outs)
+                    local_sum = None
+                else:
+                    # `where`, NOT multiplication: a poisoned excluded
+                    # client's NaN/Inf must become an exact zero in
+                    # the local sum (NaN * 0 is NaN) — this is also
+                    # what makes a screened client bit-identical to a
+                    # dropped one
+                    local_sum = jax.tree.map(
+                        lambda t: jnp.where(
+                            surv_eff.reshape(
+                                surv_eff.shape
+                                + (1,) * (t.ndim - 1)) > 0,
+                            t, jnp.zeros_like(t)).sum(axis=0),
+                        tx)
             elif surv is not None:
                 # zero dropped clients' uploads BEFORE the local sum —
                 # the psum'd aggregate and the divide-by-total see
@@ -662,6 +900,18 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             losses, metrics = results.loss, results.metrics
             new_err, new_vel = results.error, results.velocity
 
+        if pois is not None and cfg.robust_aggregation:
+            # robust aggregate (ISSUE 17): already encoded,
+            # quantized, normalized and replicated (a pure function
+            # of the all_gathered tables — every shard computed the
+            # identical value, so no psum is needed); `total` still
+            # reports the admitted example mass for the round_step
+            # alive gate and telemetry parity
+            total = jax.lax.psum(counts.sum(), "clients")
+            out = (robust_tx, total, new_err, new_vel, new_w_rows,
+                   losses, metrics, counts, admitted, contrib,
+                   agg_stats)
+            return out
         if cfg.defer_sketch_encode:
             # sketch linearity: encode the per-shard client sum ONCE
             # (clients returned dense gradients; see Config property
@@ -744,7 +994,17 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
     # screen-enable scalar, with the effective admitted mask as a
     # ninth output. Two programs — with and without the straggler
     # work operand — mirroring the default family's structure so
-    # screening composes with every fault axis for free.
+    # screening composes with every fault axis for free. Robust
+    # aggregators (ISSUE 17) extend BOTH with two further outputs —
+    # the contributors mask (per-client, sharded) and the replicated
+    # [4] aggregation-stats vector — a static config branch, so
+    # PR-16 screened configs keep their exact output arity.
+    screened_out = (P(), P(), state_spec, state_spec, state_spec,
+                    P("clients"), P("clients"), P("clients"),
+                    P("clients"))
+    if cfg.robust_aggregation:
+        screened_out = screened_out + (P("clients"), P())
+
     def _shard_train_screened(ps_weights, data, mask, err_rows,
                               vel_rows, w_rows, keys, lr, surv, pois,
                               screen):
@@ -756,9 +1016,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         in_specs=(P(), P("clients"), P("clients"), P("clients"),
                   P("clients"), P("clients"), P("clients"), P(),
                   P("clients"), P("clients"), P()),
-        out_specs=(P(), P(), state_spec, state_spec, state_spec,
-                   P("clients"), P("clients"), P("clients"),
-                   P("clients")),
+        out_specs=screened_out,
         axis_names=frozenset({"clients"}),
     )
 
@@ -767,9 +1025,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         in_specs=(P(), P("clients"), P("clients"), P("clients"),
                   P("clients"), P("clients"), P("clients"), P(),
                   P("clients"), P("clients"), P("clients"), P()),
-        out_specs=(P(), P(), state_spec, state_spec, state_spec,
-                   P("clients"), P("clients"), P("clients"),
-                   P("clients")),
+        out_specs=screened_out,
         axis_names=frozenset({"clients"}),
     )
 
@@ -840,7 +1096,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         surv = batch.survivors
         work = batch.work
         pois = batch.poison
-        admitted = None
+        admitted = contributors = agg_stats = None
         if pois is not None:
             # screened family (ISSUE 16): survivors and the traced
             # screen flag always ride with the poison operand (the
@@ -854,19 +1110,21 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
                       if batch.screen is None
                       else jnp.asarray(batch.screen, jnp.float32))
             if work is not None:
-                (transmit, total, new_err, new_vel, new_w, losses,
-                 metrics, counts,
-                 admitted) = shard_train_screened_work_mapped(
+                res = shard_train_screened_work_mapped(
                     server.ps_weights, batch.data, batch.mask,
                     err_rows, vel_rows, w_rows, client_keys, lr, surv,
                     work.astype(jnp.float32), pois, screen)
             else:
-                (transmit, total, new_err, new_vel, new_w, losses,
-                 metrics, counts,
-                 admitted) = shard_train_screened_mapped(
+                res = shard_train_screened_mapped(
                     server.ps_weights, batch.data, batch.mask,
                     err_rows, vel_rows, w_rows, client_keys, lr, surv,
                     pois, screen)
+            (transmit, total, new_err, new_vel, new_w, losses,
+             metrics, counts, admitted) = res[:9]
+            if cfg.robust_aggregation:
+                # robust programs (ISSUE 17) report the contributors
+                # mask and the aggregation-stats vector alongside
+                contributors, agg_stats = res[9], res[10]
             # a fully-screened round is a zero-survivor round: the
             # whole server update gates off and state comes through
             # bit-untouched
@@ -906,8 +1164,14 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         # stragglers, each transmit was scaled by (and `total` counts)
         # examples ACTUALLY processed, so heterogeneous work fractions
         # normalize out FedNova-style — a half-work client carries
-        # half weight, not a half-magnitude bias
-        gradient = transmit / jnp.maximum(total, 1.0)
+        # half weight, not a half-magnitude bias. A robust aggregator
+        # (ISSUE 17) already produced the NORMALIZED location estimate
+        # inside shard_train (an order statistic does not distribute
+        # over the psum/divide split), so the divide is skipped.
+        if cfg.robust_aggregation and pois is not None:
+            gradient = transmit
+        else:
+            gradient = transmit / jnp.maximum(total, 1.0)
 
         # server aggregation + decompression
         upd = fserver.get_server_update(
@@ -977,7 +1241,8 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             tele = tmetrics.empty_vector()
 
         return new_server, new_cohort, RoundMetrics(
-            losses, metrics, counts, tele, admitted)
+            losses, metrics, counts, tele, admitted, contributors,
+            agg_stats)
 
     def round_full(server: ServerState, clients: ClientState,
                    batch: RoundBatch, lr, key):

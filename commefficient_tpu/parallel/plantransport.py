@@ -115,6 +115,11 @@ def serialize_plan(plan) -> bytes:
         "est_round_s": _opt_float(plan.est_round_s),
         "expected_round_s": _opt_float(plan.expected_round_s),
     }
+    if plan.screen_mult is not None:
+        # adaptive screening (ISSUE 17): the key is CONDITIONAL so a
+        # non-adaptive run's wire bytes (and their sha256 plan
+        # identities) stay byte-identical to a pre-17 build
+        obj["screen_mult"] = float(np.float32(plan.screen_mult))
     return json.dumps(obj, sort_keys=True,
                       separators=(",", ":")).encode()
 
@@ -139,7 +144,8 @@ def deserialize_plan(payload: bytes):
         arr("active", np.float32), arr("work", np.float32),
         obj.get("deadline_s"), obj.get("est_round_s"),
         obj.get("expected_round_s"), str(obj["sampler"]),
-        arr("participants", np.int64))
+        arr("participants", np.int64),
+        screen_mult=obj.get("screen_mult"))
 
 
 def payload_digest(payload: bytes) -> str:
@@ -526,6 +532,20 @@ class MirroredControllers:
     @state_prefetch.setter
     def state_prefetch(self, fn) -> None:
         self._coord.state_prefetch = fn
+
+    @property
+    def screen_ctl(self):
+        return self._coord.screen_ctl
+
+    @screen_ctl.setter
+    def screen_ctl(self, ctl) -> None:
+        # adaptive screening (ISSUE 17): every controller carries the
+        # reference — the coordinator stamps plans from it, and a
+        # follower's is_default must go False so it installs the
+        # broadcast plan instead of skipping commit. Only the model
+        # ever calls observe(), so sharing one instance is safe.
+        for s in self.schedulers:
+            s.screen_ctl = ctl
 
     def begin_epoch(self, first_round: int) -> None:
         self._pending_select = None

@@ -74,8 +74,8 @@ from commefficient_tpu.telemetry.clients import ClientThroughputTracker
 from commefficient_tpu.telemetry.trace import TRACE
 
 __all__ = [
-    "DeadlineDecision", "DeadlinePolicy", "ParticipantSampler",
-    "RoundPlan", "RoundScheduler", "SAMPLERS",
+    "AdaptiveScreenController", "DeadlineDecision", "DeadlinePolicy",
+    "ParticipantSampler", "RoundPlan", "RoundScheduler", "SAMPLERS",
     "ThroughputAwareSampler", "UniformSampler",
     "attach_round_scheduler", "overprovision",
 ]
@@ -105,6 +105,14 @@ class RoundPlan(NamedTuple):
     # coordinator's draw instead of consulting its own tracker. None
     # on transport-free plans — nothing downstream reads it there.
     participants: Optional[np.ndarray] = None
+    # adaptive screening (ISSUE 17): the norm-screen multiplier this
+    # round dispatches with, stamped by the AdaptiveScreenController.
+    # Rides the serialized plan (conditionally — absent, the wire
+    # bytes are byte-identical to a pre-17 plan) so the threshold
+    # trajectory is coordinator-broadcast under --plan_transport and
+    # REPLAYED, not recomputed, on a deterministic restart or
+    # takeover. None whenever adaptive screening is off.
+    screen_mult: Optional[float] = None
 
     def journal_fields(self) -> dict:
         """Payload of the `schedule` journal event (None fields
@@ -117,7 +125,94 @@ class RoundPlan(NamedTuple):
                 out[name] = round(float(v), 6)
         if self.work is not None:
             out["truncated_slots"] = int((self.work < 1.0).sum())
+        if self.screen_mult is not None:
+            out["screen_mult"] = float(self.screen_mult)
         return out
+
+
+class AdaptiveScreenController:
+    """Closed-loop tuner for the norm-screen threshold (ISSUE 17).
+
+    PR 16's update screening rejects client updates whose l2 norm
+    exceeds ``screen_norm_mult`` times the cohort median — a STATIC
+    multiplier, so an operator has to guess how aggressive the screen
+    should be before seeing the run. This controller closes the loop:
+    it watches the journaled per-round screened rate and nudges the
+    multiplier multiplicatively toward ``--target_screened_rate``
+    (observed rate above target → loosen, below → tighten), clamped to
+    [screen_mult_min, screen_mult_max].
+
+    Determinism contract: every adjustment is pure f32 arithmetic on
+    journal-materialized integer counts — no wall clock, no RNG — and
+    the multiplier each round dispatches with RIDES THE ROUNDPLAN
+    (``RoundPlan.screen_mult``), coordinator-broadcast under
+    ``--plan_transport`` and replayed (not recomputed) from the
+    write-ahead journal on a restart or takeover. The traced program
+    never changes: the screen operand PR 16 already threads into the
+    jitted round carries the live multiplier as its VALUE, and its
+    plan-digest coverage (install_digest's screen_on field) extends to
+    the multiplier for free. ``screen_mult_min`` must stay > 1 so the
+    adapted value can never collide with the screen-off sentinel 0.
+
+    One instance per run, created by FedModel and shared with the
+    RoundScheduler (attach_scheduler): the model consults it for
+    transport-free dispatch, the scheduler stamps it into broadcast
+    plans. Its state rides the scheduler's sched_* checkpoint keys so
+    a resumed run continues the trajectory bit-exactly.
+    """
+
+    STATE_KEYS = ("screen_mult", "screen_rounds_observed")
+
+    def __init__(self, cfg):
+        self.target = float(cfg.target_screened_rate)
+        self.step = float(cfg.screen_adapt_step)
+        self.lo = float(cfg.screen_mult_min)
+        self.hi = float(cfg.screen_mult_max)
+        self.mult = float(np.float32(
+            min(max(float(cfg.screen_norm_mult), self.lo), self.hi)))
+        self.rounds_observed = 0
+
+    def plan_mult(self) -> float:
+        """The multiplier the NEXT round dispatches with — f32-rounded
+        so the journaled plan, the install digest, and the traced
+        screen operand all carry the identical value."""
+        return float(np.float32(self.mult))
+
+    def observe(self, round_idx: int, n_screened: int,
+                n_cohort: int) -> Optional[tuple]:
+        """Feed one committed round's observed screened count (EVERY
+        round, zero included — the controller's trajectory is a pure
+        function of the observation stream, so skipping quiet rounds
+        would desync a resumed run). Returns (old_mult, new_mult,
+        rate) when the threshold moved, else None."""
+        del round_idx  # trajectory is stream-positional, not indexed
+        self.rounds_observed += 1
+        rate = float(n_screened) / float(max(int(n_cohort), 1))
+        old = self.plan_mult()
+        if rate > self.target:
+            new = min(old * (1.0 + self.step), self.hi)
+        elif rate < self.target:
+            new = max(old / (1.0 + self.step), self.lo)
+        else:
+            new = old
+        new = float(np.float32(new))
+        self.mult = new
+        if new != old:
+            return (old, new, rate)
+        return None
+
+    def state_dict(self) -> dict:
+        return {"screen_mult": np.float64(self.mult),
+                "screen_rounds_observed": np.int64(
+                    self.rounds_observed)}
+
+    def load_state_dict(self, state: dict) -> None:
+        # legacy checkpoints (pre-17) carry no controller keys: keep
+        # the config-derived start point
+        if "screen_mult" in state:
+            self.mult = float(np.asarray(state["screen_mult"]))
+            self.rounds_observed = int(np.asarray(
+                state.get("screen_rounds_observed", 0)))
 
 
 class RoundScheduler:
@@ -176,6 +271,13 @@ class RoundScheduler:
         # (throughput selection, deadlines) from the broadcast instead
         # of their own tracker.
         self.transport = None
+        # adaptive screening (ISSUE 17): FedModel.attach_scheduler
+        # shares the run's single AdaptiveScreenController here so
+        # commit_round stamps the live multiplier into every sealed
+        # plan (and is_default goes False — adaptive runs must build
+        # plans every round for the threshold to ride the journal /
+        # broadcast). None keeps every path identical to pre-17.
+        self.screen_ctl = None
         self._last_selected: Optional[np.ndarray] = None
         self._received: Optional[RoundPlan] = None
         # deterministic-restart replay (ISSUE 12): {round: serialized
@@ -264,7 +366,8 @@ class RoundScheduler:
         traced program set) is untouched."""
         return (isinstance(self.policy, UniformSampler)
                 and self.deadline is None
-                and self.target_survivors == 0)
+                and self.target_survivors == 0
+                and self.screen_ctl is None)
 
     # ---------------- selection side (FedSampler) ------------------------
     def begin_epoch(self, first_round: int) -> None:
@@ -417,6 +520,12 @@ class RoundScheduler:
             decision.expected_round_s, self.policy.name,
             self._last_selected if self.transport is not None
             else None)
+        if self.screen_ctl is not None:
+            # adaptive screening: the CURRENT threshold rides the
+            # sealed plan, so followers dispatch the coordinator's
+            # value and a restart replays the journaled one
+            plan = plan._replace(
+                screen_mult=self.screen_ctl.plan_mult())
         self._last_selected = None
         if self.transport is not None:
             # coordinator broadcast: serialize, send once, and install
@@ -480,6 +589,10 @@ class RoundScheduler:
         # sched_* checkpoint namespace, same bit-exact-resume contract
         if hasattr(self.policy, "state_dict"):
             out.update(self.policy.state_dict())
+        # adaptive-screen controller state rides along (ISSUE 17):
+        # a resumed run continues the threshold trajectory bit-exactly
+        if self.screen_ctl is not None:
+            out.update(self.screen_ctl.state_dict())
         return out
 
     def load_state_dict(self, state: dict) -> None:
@@ -496,6 +609,8 @@ class RoundScheduler:
             "rounds_committed", state["rounds_scheduled"])))
         if hasattr(self.policy, "load_state_dict"):
             self.policy.load_state_dict(state)
+        if self.screen_ctl is not None:
+            self.screen_ctl.load_state_dict(state)
 
 
 def attach_round_scheduler(model, train_loader) -> RoundScheduler:

