@@ -1168,13 +1168,37 @@ def _last_tpu_note() -> str:
             f"vs_baseline {rec.get('vs_baseline')})")
 
 
+def _static_ulp_bounds():
+    """Per-program worst-case psum-reassociation ulp bound from the
+    graftnum baseline (ISSUE 18 satellite): the static twin of the
+    measured round-time metric, so a BENCH_*.json consumer weighing
+    the quantization estimate-residual trade-off reads the numeric
+    headroom and the speed from one record. Read from the shipped
+    exact-match baseline — tier-1 gates it against a fresh trace every
+    run — rather than re-tracing inside the bench process."""
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "graftnum.baseline.json")) as f:
+            base = json.load(f)
+        ulp = {k: int(v["worst_case_ulp"])
+               for k, v in (base.get("ulp") or {}).items()
+               if isinstance(v, dict) and "worst_case_ulp" in v}
+        if not ulp:
+            return None
+        return {"per_program": ulp, "max": max(ulp.values())}
+    except (OSError, KeyError, TypeError, ValueError):
+        return None
+
+
 def journal_digest(out, kind):
     """Append a bench digest to the shared telemetry journal (ISSUE 4
     satellite: BENCH_*.json records and training runs share one
     versioned JSONL schema — telemetry/journal.py). Path comes from
     BENCH_JOURNAL (set it to 0 to disable), defaulting to
     bench_out/telemetry.jsonl next to this file. Best-effort: a
-    journal failure must never fail the measurement itself."""
+    journal failure must never fail the measurement itself. Every
+    digest carries the static per-program reassociation ulp bound
+    next to the measured value (ISSUE 18 satellite)."""
     path = os.environ.get("BENCH_JOURNAL", "")
     if path == "0":
         return
@@ -1183,6 +1207,10 @@ def journal_digest(out, kind):
                             "bench_out", "telemetry.jsonl")
     try:
         from commefficient_tpu.telemetry.journal import append_event
+        bounds = _static_ulp_bounds()
+        if bounds is not None and isinstance(out, dict):
+            out = dict(out)
+            out["worst_case_ulp"] = bounds
         append_event(path, kind, digest=out)
         log(f"digest journaled to {path}")
     except (ImportError, OSError, TypeError, ValueError) as e:

@@ -9,12 +9,14 @@ mechanical:
 
   * `engine` + `rules` — an AST lint pass (``python -m
     commefficient_tpu.analysis <paths>``) with JAX-specific rules
-    GL001-GL010: host nondeterminism reachable from traced code, hidden
+    GL001-GL013: host nondeterminism reachable from traced code, hidden
     host syncs / trace breaks, PRNG key reuse, Python control flow over
     traced values, fault-swallowing broad ``except`` handlers,
     non-atomic file writes, unconstrained shard_map/pjit layouts,
     large exact top-k, PRNG domain tags outside the `domains`
-    registry, and mesh-axis names outside its MESH_AXES registry.
+    registry, mesh-axis names outside its MESH_AXES registry,
+    wall-clock durations, anonymous threads, and float equality on
+    traced values (the exact-zero sparsity test stays legal).
     Per-line ``# graftlint: disable=GLxxx`` suppressions and
     a baseline file grandfather justified hits.
   * `audit` + `costmodel` — the SECOND tier (``graftaudit``, ISSUE 7):
@@ -35,14 +37,37 @@ mechanical:
     per-link ICI/DCN byte report gated against
     ``meshaudit.baseline.json`` (rules AU007-AU011; exit 1 =
     violations, 2 = baseline drift, shared with graftaudit).
+  * `syncaudit` — the FOURTH tier (``graftsync``, ISSUE 14): pure-AST
+    over the five host packages, checking the shared-state guard
+    registry, the static lock-order graph, queue-ownership transfer,
+    blocking-under-lock, thread lifecycle, and the named
+    happens-before edges in `domains.ORDERING_EDGES` (rules
+    SY001-SY006; empty exact-match ``graftsync.baseline.json``).
+  * `numaudit` — the FIFTH tier (``graftnum``, ISSUE 18): re-walks
+    every registered ClosedJaxpr with a dtype/finiteness dataflow
+    lattice — NaN-unsafe mask arithmetic (the PR-16 ``t * mask``
+    class), unregistered precision downcasts vs
+    `domains.PRECISION_SEAMS` + sub-f32 error-feedback residuals,
+    unguarded division/rsqrt/log/sqrt, replay-nondeterministic
+    primitives — and prices cross-shard psum reassociation as a
+    worst-case ulp bound per program, gated exact-match in
+    ``graftnum.baseline.json`` (rules NU001-NU005; empty violations
+    baseline).
   * `domains` — the central registries: PRNG-domain tags (dropout /
     straggler / sampler) whose uniqueness GL009 and an import-time
-    assert both enforce, and the MESH_AXES axis-name registry GL010
-    holds the sharding layer to.
+    assert both enforce, the MESH_AXES axis-name registry GL010
+    holds the sharding layer to, the SHARED_STATE guard map and
+    ORDERING_EDGES happens-before registry graftsync enforces, and
+    the PRECISION_SEAMS lossy-cast registry graftnum enforces.
   * `runtime` — sanitizers armed by tests: ``assert_program_count(n)``
-    (a compilation counter enforcing the three-programs contract) and
+    (a compilation counter enforcing the three-programs contract),
     ``forbid_transfers()`` (``jax.transfer_guard`` proving the jitted
-    round performs zero implicit host transfers).
+    round performs zero implicit host transfers), the
+    ``LockOrderSanitizer`` (observed lock-acquisition graph asserted
+    acyclic — graftsync's runtime twin), and the
+    ``NumericSanitizer`` (post-dispatch finite guard over exported
+    round metrics + the bitwise replay drill — graftnum's runtime
+    twin).
 
 The lint pass is deliberately jax-free (pure ``ast``) so it runs in
 any environment — only `runtime` and `audit`'s tracing functions

@@ -464,3 +464,70 @@ def collective_cost(jaxpr, link: MeshLinkModel) -> CollectiveCost:
 
     walk(jaxpr, 1)
     return cost
+
+
+# ---------------------------------------------------------------------------
+# reassociation ulp bound (graftnum, ISSUE 18)
+#
+# Floating-point addition is not associative: summing the same n shard
+# contributions in two different association orders can differ by up to
+# (n - 1) rounding steps — the textbook worst-case forward bound for
+# recursive summation, |err| <= (n - 1) * eps * sum|x| (Higham, ch. 4),
+# i.e. (n - 1) result-ulps per element. Within one compiled program XLA
+# fixes the reduction order, so single-device replay is bit-exact; the
+# order that is NOT fixed by any spec is the cross-shard combine of a
+# psum-class collective (topology, ring direction, and slice layout all
+# legally reassociate it). graftnum therefore PRICES that exposure
+# instead of flagging it: per program, the sum over sum-type
+# collectives of container-multiplier x (participants - 1), an integer
+# that moves exactly when a program adds a collective, widens an axis,
+# or scans more rounds per dispatch — and is diffed exact-match in
+# graftnum.baseline.json like FLOPs/HBM are in audit.baseline.json.
+
+# sum-type collectives only: pmax/pmin are exact order-free selections
+# and the data-movement collectives (all_gather, ppermute, all_to_all,
+# pbroadcast) round nothing
+_REASSOC_COLLECTIVES = frozenset({
+    "psum", "psum2", "psum_invariant", "reduce_scatter",
+})
+
+
+def _reduces_floats(eqn) -> bool:
+    return any(str(getattr(a, "dtype", "")).startswith(("float",
+                                                        "bfloat"))
+               for a in _operand_avals(eqn))
+
+
+def reassociation_ulp_bound(jaxpr, axis_sizes: Dict[str, int],
+                            default_axis_size: int = 2) -> int:
+    """Worst-case per-element ulp divergence between two legal
+    reassociations of `jaxpr`'s cross-shard sum reductions.
+
+    `axis_sizes` maps named mesh axes to participant counts (an axis
+    the caller did not declare prices at `default_axis_size` — the
+    smallest exposure a real multi-participant axis can have, so an
+    unregistered axis is never silently free). Integer psums are exact
+    and price 0. Deterministic given the jaxpr, like jaxpr_cost."""
+    inner = getattr(jaxpr, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        jaxpr = inner
+    total = 0
+
+    def walk(jx, mult):
+        nonlocal total
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in _REASSOC_COLLECTIVES and _reduces_floats(eqn):
+                n = 1
+                for a in eqn_collective_axes(eqn):
+                    n *= max(int(axis_sizes.get(a, default_axis_size)),
+                             1)
+                if n > 1:
+                    total += mult * (n - 1)
+            sub_mult = mult * _container_multiplier(eqn)
+            for v in eqn.params.values():
+                for s in sub_jaxprs(v):
+                    walk(s, sub_mult)
+
+    walk(jaxpr, 1)
+    return int(total)

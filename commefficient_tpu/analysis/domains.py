@@ -219,3 +219,66 @@ for _name, _edge in ORDERING_EDGES.items():
     assert _edge["before"] != _edge["after"], (
         f"ORDERING_EDGES[{_name!r}]: before and after name the same "
         "call — the edge is vacuous")
+
+
+# ---------------------------------------------------------------------------
+# precision-seam registry (ISSUE 18; enforced by graftnum NU002)
+#
+# The engine's numeric contract is f32 master state end to end; every
+# place a value deliberately LOSES precision — the PR-6 sketch-table
+# wire quantization, the flash-attention output cast back to the
+# activation dtype — is a SEAM the convergence analysis must account
+# for (the quantization rounding rides the error-feedback residual,
+# PERF.md round 6). Before this registry those seams lived as .astype
+# calls spread through ops/; nothing stopped a refactor from adding a
+# new silent downcast on a path the analysis assumes exact. graftnum
+# NU002 holds the line at the PROGRAM level: every lossy
+# `convert_element_type` in a traced round program must match a
+# (src, dst) pair registered here, and an unregistered downcast is an
+# audit error — new seams must be declared (and their residual story
+# told in `why`) before they ship. Upcasts and exact index casts
+# (float -> int32/int64) are not seams and need no entry.
+#
+# Dtype names are the str() of the jax/numpy dtype ("float32",
+# "bfloat16", "int8"), kept as strings so this module stays
+# stdlib-only.
+PRECISION_SEAMS = {
+    "sketch-wire-bf16": {
+        "src": "float32", "dst": "bfloat16",
+        "path": "commefficient_tpu/ops/kernels/quant.py",
+        "function": "quantize_table",
+        "why": "the bf16 sketch-table wire format (PR 6): the rounding "
+               "is bounded per-cell and lands in the error-feedback "
+               "residual, which FetchSGD re-transmits",
+    },
+    "sketch-wire-int8": {
+        "src": "float32", "dst": "int8",
+        "path": "commefficient_tpu/ops/kernels/quant.py",
+        "function": "quantize_table",
+        "why": "the int8 symmetric sketch-table wire format (PR 6): "
+               "per-row scale rides beside the payload, quantization "
+               "noise lands in the error-feedback residual",
+    },
+    "attention-output-cast": {
+        "src": "float32", "dst": "bfloat16",
+        "path": "commefficient_tpu/ops/attention.py",
+        "function": "flash_attention",
+        "why": "the flash-attention f32 accumulator is cast back to "
+               "the bf16 activation dtype on exit — the standard "
+               "mixed-precision activation seam, outside the "
+               "error-feedback loop",
+    },
+}
+
+for _name, _seam in PRECISION_SEAMS.items():
+    assert {"src", "dst", "path", "function", "why"} <= set(_seam), (
+        f"PRECISION_SEAMS[{_name!r}] is missing a required field")
+    assert _seam["src"] != _seam["dst"], (
+        f"PRECISION_SEAMS[{_name!r}]: src and dst name the same dtype "
+        "— the seam is vacuous")
+
+
+def precision_seam_pairs() -> set:
+    """The registered (src dtype name, dst dtype name) pairs — what
+    graftnum NU002 matches traced convert_element_type eqns against."""
+    return {(s["src"], s["dst"]) for s in PRECISION_SEAMS.values()}

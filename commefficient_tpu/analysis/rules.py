@@ -897,6 +897,72 @@ def check_gl012(module: ModuleInfo) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GL013 — float equality comparison on traced values (ISSUE 18)
+
+# The crash->resume contract (graftnum NU004) makes BIT-exactness the
+# replay guarantee, and FetchSGD's error feedback leans on one legal
+# float-equality idiom: comparison against EXACT ZERO (`update == 0`,
+# `vals == 0.0`) — a coordinate is either untouched or was assigned
+# 0.0 through a `where`, so the test is a bit test, not an
+# approximation. Every OTHER float equality in traced code is a
+# rounding hazard: `x == 0.95` is False for the nearest f32 to 0.95
+# after one ulp of drift, and `computed == computed'` couples program
+# logic to reassociation order (exactly what graftnum's NU005 ulp
+# bound prices as nonzero). The rule is AST-level and so heuristic:
+# it flags equality against a non-zero FLOAT literal, and equality
+# where a side is a clearly-traced jnp/lax expression — int-literal
+# comparisons (ids, chunk indices) and bare-name pairs stay quiet.
+
+
+def _zero_literal(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool)
+            and float(node.value) == 0.0)
+
+
+def check_gl013(module: ModuleInfo) -> Iterator[Violation]:
+    for node in _walk_traced(module):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not all(isinstance(op, (ast.Eq, ast.NotEq))
+                   for op in node.ops):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if any(_zero_literal(s) for s in sides):
+            # the sanctioned sparsity/sentinel bit test (`update ==
+            # 0` error-feedback masking, `vals == 0.0` unfilled-slot
+            # sentinel): exact by construction, replay-stable
+            continue
+        float_lit = next(
+            (s.value for s in sides
+             if isinstance(s, ast.Constant)
+             and isinstance(s.value, float)), None)
+        if float_lit is not None:
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL013",
+                f"float equality against {float_lit!r} in traced "
+                "code: one ulp of drift (psum reassociation, a "
+                "backend change) flips this comparison, breaking the "
+                "crash->resume bit-exactness contract — compare "
+                "against exact 0 (the sparsity idiom), use an "
+                "inequality threshold, or jnp.isclose with an "
+                "explicit tolerance")
+            continue
+        hit = next((h for h in map(_traced_value_expr, sides) if h),
+                   None)
+        if hit is not None:
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL013",
+                f"float `==`/`!=` on a computed traced value ({hit}): "
+                "equality between computed floats couples logic to "
+                "summation/reassociation order (graftnum prices that "
+                "drift as a nonzero ulp bound) — compare against "
+                "exact 0, use an inequality threshold, or "
+                "jnp.isclose with an explicit tolerance")
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "GL001": check_gl001,
@@ -911,6 +977,7 @@ ALL_RULES = {
     "GL010": check_gl010,
     "GL011": check_gl011,
     "GL012": check_gl012,
+    "GL013": check_gl013,
 }
 
 RULE_DOCS = {
@@ -942,4 +1009,7 @@ RULE_DOCS = {
     "GL012": "threading.Thread constructed without an explicit name= "
              "(anonymous Thread-N names break graftscope's "
              "thread-keyed trace rows across restarts)",
+    "GL013": "float ==/!= on traced values (non-zero literal or "
+             "computed comparand) — one ulp of reassociation drift "
+             "flips it; exact-zero sparsity tests stay legal",
 }

@@ -44,14 +44,29 @@ not prose:
     controlplane suites via the `CCTPU_SYNC_SANITIZE=1` autouse
     fixture (tests/conftest.py).
 
+  * `NumericSanitizer` — graftnum's runtime twin (ISSUE 18).
+    Installed, it wraps `telemetry.metrics.named` (the ONE host
+    boundary every exported round metric crosses) in a post-dispatch
+    finite-guard: any NaN/inf reaching an export raises
+    `NumericError` naming the metric — the dynamic check behind the
+    static NU001 lattice's one assumption (that a `where` guard's
+    predicate is semantically sufficient). `replay_drill(fn, *args)`
+    dispatches a traced program twice on identical operands and
+    asserts bitwise equality leaf by leaf — the executable form of
+    the NU004 crash->resume contract. tier1.sh re-runs the
+    valuefaults/byzantine suites with the guard armed via the
+    `CCTPU_NUM_SANITIZE=1` autouse fixture (tests/conftest.py).
+
 The `sanitize` pytest fixture (tests/conftest.py) hands tests the
 program-count/transfer pair; `lock_sanitizer` hands them an
-installed LockOrderSanitizer.
+installed LockOrderSanitizer; `num_sanitizer` an installed
+NumericSanitizer.
 """
 from __future__ import annotations
 
 import contextlib
 import itertools
+import math
 import queue as _queue
 import sys
 import threading
@@ -363,6 +378,119 @@ class LockOrderSanitizer:
             + "\npick ONE global acquisition order (graftsync SY002 "
             "checks the static `with` nesting; this caught an order "
             "composed at runtime)")
+
+
+# ---------------------------------------------------------------------------
+# NumericSanitizer — graftnum's runtime twin (ISSUE 18)
+
+
+class NumericError(AssertionError):
+    """A non-finite value crossed a guarded numeric boundary (an
+    exported round metric, a replay-drill mismatch): the static
+    graftnum lattice proved the shipped guards are selects, this
+    caught a predicate that was not semantically sufficient — or a
+    program that did not replay bit-identically."""
+
+
+class NumericSanitizer:
+    """Scoped post-dispatch numeric guard.
+
+    `install()` wraps `telemetry.metrics.named` — the single host
+    boundary every exported round-metric vector crosses (the round
+    engine, the telemetry writers, and bench all call it by module
+    attribute) — so any NaN/inf that survived the on-device guards
+    raises `NumericError` at the EXPORT, naming the metric, instead
+    of poisoning a CSV three stages later. `uninstall()` restores the
+    original; both are idempotent. `.checked` counts guarded vectors
+    (a zero after a drill means the guard never saw traffic — arm it
+    before the workload, like the program counter).
+
+    `replay_drill(fn, *args, **kwargs)` is the NU004 contract made
+    executable: dispatch `fn` twice on the SAME operands and assert
+    the results bitwise-identical leaf by leaf (bytes of the
+    materialized arrays — NaNs compare equal by representation, so a
+    deterministic NaN is replay-clean, as the crash->resume contract
+    requires). Returns the first call's result."""
+
+    def __init__(self):
+        self._orig = None
+        self.checked = 0
+
+    # ---------------- metric finite-guard ------------------------------
+    def _guarded(self, orig):
+        def named(vec):
+            out = orig(vec)
+            self.checked += 1
+            bad = {k: v for k, v in out.items()
+                   if not math.isfinite(v)}
+            if bad:
+                raise NumericError(
+                    "non-finite round metric(s) exported: "
+                    + ", ".join(f"{k}={v}" for k, v in
+                                sorted(bad.items()))
+                    + " — a NaN/inf survived the on-device admission "
+                    "guards (graftnum NU001/NU003 prove the guards "
+                    "are selects; this predicate was not sufficient "
+                    "— see analysis/runtime.py)")
+            return out
+        return named
+
+    def install(self) -> None:
+        from commefficient_tpu.telemetry import metrics as tmetrics
+        if self._orig is not None:
+            return
+        self._orig = tmetrics.named
+        tmetrics.named = self._guarded(self._orig)
+
+    def uninstall(self) -> None:
+        from commefficient_tpu.telemetry import metrics as tmetrics
+        if self._orig is None:
+            return
+        tmetrics.named = self._orig
+        self._orig = None
+
+    def __enter__(self):
+        self.install()
+        return self
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # ---------------- determinism drill --------------------------------
+    @staticmethod
+    def assert_finite(tree, where: str = "value") -> None:
+        """Raise NumericError if any float leaf of `tree` holds a
+        NaN/inf (non-float and zero-size leaves pass)."""
+        import numpy as np
+        for i, leaf in enumerate(jax.tree.leaves(tree)):
+            arr = np.asarray(jax.device_get(leaf))
+            if arr.dtype.kind != "f" or not arr.size:
+                continue
+            if not np.isfinite(arr).all():
+                n = int((~np.isfinite(arr)).sum())
+                raise NumericError(
+                    f"non-finite values at {where} (leaf {i}): "
+                    f"{n}/{arr.size} element(s) NaN/inf")
+
+    @staticmethod
+    def replay_drill(fn, *args, **kwargs):
+        import numpy as np
+        first = fn(*args, **kwargs)
+        second = fn(*args, **kwargs)
+        la = jax.tree.leaves(first)
+        lb = jax.tree.leaves(second)
+        for i, (a, b) in enumerate(zip(la, lb)):
+            ba = np.asarray(jax.device_get(a)).tobytes()
+            bb = np.asarray(jax.device_get(b)).tobytes()
+            if ba != bb:
+                raise NumericError(
+                    f"replay divergence: leaf {i} of {len(la)} "
+                    "differs bitwise between two dispatches on "
+                    "identical operands — the crash->resume "
+                    "bit-exactness contract (graftnum NU004) does "
+                    "not hold for this program")
+        return first
 
 
 @contextlib.contextmanager

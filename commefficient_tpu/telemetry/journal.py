@@ -119,6 +119,12 @@ consumers must tolerate kinds they don't know):
                           per-rule `rules` counts, the `registry`
                           sizes (shared-state guards / ordering
                           edges), and the finding count
+  num_audit_digest        graftnum's numerics-audit report
+                          (analysis/numaudit): 64-hex sha256
+                          `digest` (bit-identical across runs),
+                          per-rule NU `rules` counts, per-program
+                          `ulp` worst-case reassociation bounds, and
+                          the finding count
 """
 from __future__ import annotations
 
@@ -503,6 +509,12 @@ def validate_journal(path: str,
         object mapping each SY rule to a non-negative integer count,
         and a non-negative integer `findings` — the record tier1's
         sync step journals, so its shape must not rot;
+      * `num_audit_digest` events (graftnum numerics reports,
+        analysis/numaudit) carry the same 64-hex `digest` / `rules`
+        counts / optional `findings` shape plus a `ulp` object
+        mapping each audited program to a non-negative integer
+        worst-case reassociation bound — the record tier1's NUM step
+        journals, so its shape must not rot;
       * `screened` events (ISSUE 16 value-fault admission) carry an
         integer `round`, a non-negative integer `n_screened`, and a
         non-empty string `kind`;
@@ -749,35 +761,52 @@ def validate_journal(path: str,
                                 f"record {n}: {ev} program "
                                 f"{prog!r} `{field}` must be a "
                                 f"non-negative number (got {v2!r})")
-        if rec.get("event") == "sync_audit_digest":
-            # graftsync (analysis/syncaudit): the digest is pinned to
-            # 64-hex — the bit-identical-across-runs claim is checked
-            # on exactly this value, so a truncated or non-canonical
+        if rec.get("event") in ("sync_audit_digest",
+                                "num_audit_digest"):
+            # graftsync/graftnum: the digest is pinned to 64-hex —
+            # the bit-identical-across-runs claim is checked on
+            # exactly this value, so a truncated or non-canonical
             # digest is a schema rot, not a style choice
+            ev2 = rec.get("event")
             d = rec.get("digest")
             if not (isinstance(d, str) and len(d) == 64
                     and all(c in "0123456789abcdef" for c in d)):
                 problems.append(
-                    f"record {n}: sync_audit_digest `digest` must be "
+                    f"record {n}: {ev2} `digest` must be "
                     f"a 64-char lowercase hex string (got {d!r})")
             rls = rec.get("rules")
             if not isinstance(rls, dict):
                 problems.append(
-                    f"record {n}: sync_audit_digest `rules` is not "
+                    f"record {n}: {ev2} `rules` is not "
                     "an object")
             else:
                 for rule, cnt in sorted(rls.items()):
                     if not (isinstance(cnt, int) and cnt >= 0):
                         problems.append(
-                            f"record {n}: sync_audit_digest rule "
+                            f"record {n}: {ev2} rule "
                             f"{rule!r} count must be a non-negative "
                             f"integer (got {cnt!r})")
             fnd = rec.get("findings")
             if fnd is not None and not (isinstance(fnd, int)
                                         and fnd >= 0):
                 problems.append(
-                    f"record {n}: sync_audit_digest `findings` must "
+                    f"record {n}: {ev2} `findings` must "
                     f"be a non-negative integer (got {fnd!r})")
+        if rec.get("event") == "num_audit_digest":
+            # graftnum additionally journals the per-program
+            # worst-case reassociation ulp bounds the baseline diffs
+            ulp = rec.get("ulp")
+            if not isinstance(ulp, dict):
+                problems.append(
+                    f"record {n}: num_audit_digest `ulp` is not an "
+                    "object")
+            else:
+                for prog, bound in sorted(ulp.items()):
+                    if not (isinstance(bound, int) and bound >= 0):
+                        problems.append(
+                            f"record {n}: num_audit_digest program "
+                            f"{prog!r} ulp bound must be a "
+                            f"non-negative integer (got {bound!r})")
         if rec.get("event") == "run_end":
             total_down = _comm_field(rec, n, "down_bytes_total")
             total_up = _comm_field(rec, n, "up_bytes_total")
@@ -881,9 +910,22 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     trace_dropped = 0
     cadence: List[float] = []
     prev_mono = None
+    # the four analysis tiers' journaled report digests (last record
+    # of each wins — a re-run within one journal supersedes)
+    tier_digests: dict = {}
+    num_findings = None
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
+        if kind in ("audit_digest", "mesh_audit_digest",
+                    "sync_audit_digest", "num_audit_digest"):
+            d = rec.get("digest")
+            if isinstance(d, str) and d:
+                tier_digests[kind] = d
+            if kind == "num_audit_digest":
+                f2 = rec.get("findings")
+                if isinstance(f2, int):
+                    num_findings = f2
         if kind == "run_start":
             # new segment: a resumed process has its own monotonic
             # base, so cross-segment deltas are meaningless
@@ -1001,6 +1043,13 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
             out["writer_queue_max"] = dict(sorted(qmax.items()))
         if trace_dropped:
             out["trace_dropped"] = trace_dropped
+    if tier_digests:
+        # the analysis tiers' report digests side by side (graftaudit
+        # / graftmesh / graftsync / graftnum), so "which exact audit
+        # reports does this run vouch for" is one summary read
+        out["analysis_digests"] = dict(sorted(tier_digests.items()))
+        if num_findings is not None:
+            out["num_audit_findings"] = num_findings
     if corrupt_lines:
         out["corrupt_lines"] = int(corrupt_lines)
     return out

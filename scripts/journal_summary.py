@@ -24,6 +24,15 @@ queue-depth gauges (`writer_queue_max`), and `overlap_efficiency`
 (device-busy / wall over the `device_execute` span union). Export the
 same spans to Perfetto with scripts/trace_export.py.
 
+ISSUE 18 (graftnum): analysis-audit events may carry a
+`num_audit_digest` — the sha256 of the canonical graftnum numerics
+report.  The validator holds it to the same 64-hex-char contract as
+the other analysis digests and checks the `ulp` worst-case
+reassociation bounds block (non-negative ints per program); the
+summary surfaces the digests (`analysis_digests`) and finding count
+(`num_audit_findings`) so a CI run records which numerics contract it
+was green against.
+
 Usage:
     python scripts/journal_summary.py <journal.jsonl> [--quiet]
 

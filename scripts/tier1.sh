@@ -51,6 +51,21 @@ timeout -k 10 120 bash "$(dirname "$0")/sync.sh" --journal "$SYJR" \
     || { echo "GRAFTSYNC_FAILED"; exit 1; }
 python scripts/journal_summary.py "$SYJR" \
     || { echo "SYNC_JOURNAL_INVALID"; exit 1; }
+# numerics audit fifth (ISSUE 18): graftnum — walk every registered
+# program's ClosedJaxpr with the dtype/finiteness dataflow lattice and
+# check NaN-unsafe mask arithmetic, the PRECISION_SEAMS downcast
+# registry, zero-guarded denominators, and replay-determinism (rules
+# NU001-NU005; empty exact-match baseline), plus the per-program
+# worst-case reassociation ulp bound. Exit 1 = contract violation,
+# 2 = baseline drift; either fails tier-1. Its num_audit_digest is
+# journaled and the journal must validate, so the digest record format
+# is exercised every CI run.
+NJR=/tmp/_t1_numaudit.jsonl
+rm -f "$NJR"
+timeout -k 10 300 bash "$(dirname "$0")/num.sh" --journal "$NJR" \
+    || { echo "GRAFTNUM_FAILED"; exit 1; }
+python scripts/journal_summary.py "$NJR" \
+    || { echo "NUM_JOURNAL_INVALID"; exit 1; }
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -80,6 +95,23 @@ if [ "$rc" -eq 0 ]; then
       > /tmp/_t1_sync.log 2>&1 \
       || { echo "SYNC_SANITIZED_SUITES_FAILED"; \
            tail -60 /tmp/_t1_sync.log; exit 1; }
+
+  # numeric-sanitized value-fault suites (ISSUE 18): the valuefaults /
+  # byzantine markers — the suites that deliberately push poison and
+  # adversarial updates through the round — re-run with graftnum's
+  # runtime twin armed (CCTPU_NUM_SANITIZE=1, tests/conftest.py): every
+  # exported round-metric vector passes a post-dispatch finite guard,
+  # so a NaN/inf that screening or robust aggregation should have
+  # absorbed but instead leaked into telemetry fails tier-1 with the
+  # offending metric named.
+  rm -f /tmp/_t1_num.log
+  timeout -k 10 600 env JAX_PLATFORMS=cpu CCTPU_NUM_SANITIZE=1 \
+      python -m pytest tests/ -q \
+      -m 'valuefaults or byzantine' \
+      -p no:cacheprovider -p no:xdist -p no:randomly \
+      > /tmp/_t1_num.log 2>&1 \
+      || { echo "NUM_SANITIZED_SUITES_FAILED"; \
+           tail -60 /tmp/_t1_num.log; exit 1; }
 
   JR=/tmp/_t1_journal.jsonl
   rm -f "$JR"

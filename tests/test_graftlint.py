@@ -319,6 +319,37 @@ GL012_NEG = """
             return threading.Thread(None, self._run, "journal-writer")
 """
 
+GL013_POS = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def admit(weight, target):
+        # non-zero float literal: one ulp of drift flips it
+        exact = weight == 0.95
+        # computed-vs-computed: couples logic to reduction order
+        matched = jnp.sum(weight) != target
+        return exact, matched
+"""
+GL013_NEG = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def sparsity(update, vals, idx, d):
+        # exact-zero bit tests: the sanctioned sparsity/sentinel
+        # idiom (error-feedback masking, unfilled-slot sentinels)
+        realized = jnp.sum(update != 0)
+        slots = jnp.where(vals == 0.0, d, idx)
+        return realized, slots
+
+    @jax.jit
+    def labels_match(preds, labels, ignore):
+        # bare-name / int comparisons (ids, label indices) are out
+        # of scope for an AST heuristic
+        return (preds == labels) & (labels != ignore)
+"""
+
 # rule -> (positive, negative[, lint path]); GL010 is path-scoped to
 # the packages that construct shardings, so its fixtures lint under a
 # parallel/ path (everything else uses the default snippet.py)
@@ -336,6 +367,7 @@ FIXTURES = {
               "commefficient_tpu/parallel/snippet.py"),
     "GL011": (GL011_POS, GL011_NEG),
     "GL012": (GL012_POS, GL012_NEG),
+    "GL013": (GL013_POS, GL013_NEG),
 }
 
 

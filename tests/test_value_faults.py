@@ -329,6 +329,7 @@ def _assert_trip_rollback_journal(jr, ck):
     assert np.isfinite(np.asarray(ckpt.server.ps_weights)).all()
 
 
+@pytest.mark.nonfinite_ok
 def test_poison_trip_rollback_completes(tmp_path):
     """The rollback drill, end to end through cv_train on the scanned
     path: random NaN poison trips the telemetry watch mid-run, the
@@ -407,6 +408,7 @@ def test_poison_trip_rollback_completes(tmp_path):
 
 
 @pytest.mark.pipeline
+@pytest.mark.nonfinite_ok
 def test_poison_trip_rollback_completes_pipelined(tmp_path):
     """The same drill under --pipeline: the trip surfaces from the
     one-span-late collect with the next span already dispatched and
