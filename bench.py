@@ -461,6 +461,61 @@ def main() -> int:
         except Exception as e:
             log(f"{agg}-aggregator measurement failed: {e}")
 
+    # compressor-plugin sweep (ISSUE 19): the same workload through
+    # the powersgd plugin at rank 1/2/4 and the dp_sketch plugin.
+    # These modes carry DIFFERENT state geometry (powersgd: dense [D]
+    # server tables + client error/warm-Q rows; dp_sketch: the sketch
+    # table plus clip+noise), so each arm initializes its own state —
+    # unlike the table-dtype arms, the sketch operands cannot be
+    # reused.
+    def _mode_cfg(name, **kw):
+        return cfg.replace(mode=name, **kw).validate()
+
+    comp_arms = []
+    for r in (1, 2, 4):
+        comp_arms.append((f"powersgd_r{r}", _mode_cfg(
+            "powersgd", error_type="local", powersgd_rank=r)))
+    comp_arms.append(("dp_sketch", _mode_cfg(
+        "dp_sketch", dp_clip=1.0, dp_noise_mult=1.0)))
+    compressor_ms = {}
+    compressor_bytes = {}
+    for name, cfg_c in comp_arms:
+        compressor_bytes[name] = int(cfg_c.upload_bytes)
+        try:
+            server_c = fround.init_server_state(cfg_c, vec)
+            clients_c = fround.init_client_state(
+                cfg_c, cfg_c.resolved_num_clients(), vec, mesh=mesh)
+            digest_c = build_digest(cfg_c)
+            with alarm_guard(STAGE_TIMEOUT,
+                             f"{name} compile+measure"):
+                float(np.asarray(digest_c(
+                    server_c, clients_c, batches, lrs, key)))
+                compressor_ms[name] = median_ms(
+                    digest_c,
+                    (server_c, clients_c, batches, lrs, key),
+                    divisor=ROUNDS)
+        except StageTimeout:
+            log(f"{name} measurement timed out; omitting")
+        except Exception as e:
+            log(f"{name} measurement failed: {e}")
+    # exact bytes one client ships per round in every mode at THIS
+    # geometry (Config.upload_bytes — the figure the accountant
+    # bills): pure config math, reported even when a timing arm fails
+    bytes_per_mode = {"sketch": int(cfg.upload_bytes),
+                      **compressor_bytes}
+    for name, kw in (
+            ("true_topk", dict(error_type="virtual")),
+            ("local_topk", dict(error_type="local")),
+            ("fedavg", dict(error_type="none", virtual_momentum=0.9,
+                            local_batch_size=-1,
+                            fedavg_batch_size=LOCAL_BATCH)),
+            ("uncompressed", dict(error_type="none"))):
+        try:
+            bytes_per_mode[name] = int(_mode_cfg(name,
+                                                 **kw).upload_bytes)
+        except Exception as e:
+            log(f"{name} bytes-on-wire config failed: {e}")
+
     out = {
         "metric": "cifar10_resnet9_sketch_round_time",
         "value": round(round_ms, 3),
@@ -511,6 +566,12 @@ def main() -> int:
     out["upload_bytes_on_wire"] = {
         td: cfg.replace(sketch_table_dtype=td).upload_bytes
         for td in ("f32", "bf16", "int8")}
+    for name, ms in sorted(compressor_ms.items()):
+        # compressor-plugin arms (ISSUE 19): vs_sketch_<name> > 1.0
+        # means the plugin round is faster than the flagship sketch
+        out[f"value_{name}"] = round(ms, 3)
+        out[f"vs_sketch_{name}"] = round(round_ms / ms, 3)
+    out["bytes_on_wire_per_mode"] = dict(sorted(bytes_per_mode.items()))
     add_flops_fields(out, flops_per_round, round_ms, device_kind)
     print(json.dumps(out), flush=True)
     return 0

@@ -104,7 +104,7 @@ def make_data(seed=0, num_clients=10):
 
 
 def run_mode(mode: str, train_set, val_set, seed=0, label=None,
-             down_k_mult=0, num_fedavg_epochs=1):
+             down_k_mult=0, num_fedavg_epochs=1, table_dtype="f32"):
     D_kw = {} if FULL else {"channels": {"prep": 8, "layer1": 16,
                                          "layer2": 16, "layer3": 16}}
     # batchnorm on (the --do_batchnorm surface both frameworks expose):
@@ -154,6 +154,7 @@ def run_mode(mode: str, train_set, val_set, seed=0, label=None,
                      num_rows=5, num_cols=max(D // 13, 256), num_blocks=1,
                      k=max(D // 50, 64),
                      down_k=down_k_mult * max(D // 50, 64),
+                     sketch_table_dtype=table_dtype,
                      do_topk_down=(mode == "sketch_topk_down"), **base)
     elif mode == "fedavg":
         # the paper's FedAvg baseline: whole-client local SGD at the
@@ -222,6 +223,7 @@ def run_mode(mode: str, train_set, val_set, seed=0, label=None,
     return {"mode": label or mode, "grad_size": D,
             "num_clients": int(train_set.num_clients),
             "upload_floats_per_client_round": model.cfg.upload_floats,
+            "upload_bytes_per_client_round": model.cfg.upload_bytes,
             "curve": curve}
 
 
@@ -259,6 +261,14 @@ def main():
     runs += [seeded("fedavg_e4", lambda s: run_mode(
         "fedavg", *data[s], seed=s, label="fedavg_e4",
         num_fedavg_epochs=4))]
+    # sketch table-transport dtype arm (ISSUE 19 satellite): the same
+    # sketch run with the client->server table narrowed on the wire to
+    # bf16 / int8 (Config.sketch_table_dtype; server decode still runs
+    # f32). The claim: transport quantization buys its 2x/~4x byte
+    # cut at an accuracy cost within seed noise of the f32 table.
+    runs += [seeded(f"sketch_{td}", lambda s, td=td: run_mode(
+        "sketch", *data[s], seed=s, label=f"sketch_{td}",
+        table_dtype=td)) for td in ("bf16", "int8")]
     # download top-k pair at sparse participation: with 40 clients each
     # participates ~1 round in 5, accumulating several rounds of
     # changed coordinates between downloads — the regime --topk_down
@@ -316,6 +326,16 @@ def main():
             acc("sketch_topk_down_40c_down4x"),
         "sketch_topk_down_40c_down16x_final_acc":
             acc("sketch_topk_down_40c_down16x"),
+        "sketch_bf16_final_acc": acc("sketch_bf16"),
+        "sketch_int8_final_acc": acc("sketch_int8"),
+        "sketch_bf16_wire_cut_x": round(
+            by_mode["sketch"]["upload_bytes_per_client_round"]
+            / by_mode["sketch_bf16"]["upload_bytes_per_client_round"],
+            2),
+        "sketch_int8_wire_cut_x": round(
+            by_mode["sketch"]["upload_bytes_per_client_round"]
+            / by_mode["sketch_int8"]["upload_bytes_per_client_round"],
+            2),
         "sketch_upload_compression_x": round(sk_ratio, 2),
         "local_topk_upload_compression_x": round(lt_ratio, 2),
         "max_seed_spread": max(r["final_acc_spread"] for r in runs),
@@ -353,6 +373,19 @@ def main():
     assert sk_ratio >= 2.5, "sketch table not compressed (ref ratio 2.6x)"
     assert acc("local_topk") > acc("uncompressed") - 0.1 \
         - spread("local_topk"), "local_topk fell far behind uncompressed"
+    # table-transport dtype arm (ISSUE 19): the quantized tables must
+    # hold their byte cut (pure config math) AND stay within a few
+    # points + seed noise of the f32 table's accuracy
+    assert results["summary"]["sketch_bf16_wire_cut_x"] >= 2.0, \
+        "bf16 table transport lost its 2x byte cut"
+    assert results["summary"]["sketch_int8_wire_cut_x"] >= 3.0, \
+        "int8 table transport lost its ~4x byte cut"
+    assert acc("sketch_bf16") > acc("sketch") - 0.05 \
+        - spread("sketch_bf16"), \
+        "bf16 table transport cost more than a few points vs f32"
+    assert acc("sketch_int8") > acc("sketch") - 0.08 \
+        - spread("sketch_int8"), \
+        "int8 table transport cost more than a few points vs f32"
     assert lt_ratio >= 10, "local_topk upload not >=10x compressed"
     assert acc("fedavg") > 0.5, "fedavg failed to learn"
     # fedavg trains ~16x fewer aggregation rounds than the per-batch

@@ -430,6 +430,22 @@ def audit_configs(backends: Sequence[str] = ("xla", "pallas"),
         num_cols=g["cols"], num_blocks=1, kernel_backend="xla",
         update_screen="norm", byzantine_rate=0.2, attack="sign_flip",
         aggregator="trimmed_mean", **base).validate()))
+    # compressor plugins (ISSUE 19): the two new plugin families.
+    # powersgd rides the client-state blocks (warm Q factors in the
+    # velocities block, EF residual in errors) — its Gram-Schmidt /
+    # factor-matmul arithmetic is priced and contract-checked like
+    # every other program.
+    out.append(("powersgd", Config(
+        mode="powersgd", error_type="local", local_momentum=0.0,
+        powersgd_rank=2, **base).validate()))
+    # dp_sketch: the sketch pipeline plus per-client l2 clipping and
+    # one post-aggregation Gaussian noise draw on the registered "dp"
+    # PRNG domain — the privacy arithmetic traced in-program.
+    out.append(("dp-sketch", Config(
+        mode="dp_sketch", error_type="virtual", virtual_momentum=0.9,
+        local_momentum=0.0, k=g["k"], num_rows=g["rows"],
+        num_cols=g["cols"], num_blocks=1, dp_clip=1.0,
+        dp_noise_mult=1.0, **base).validate()))
     return out
 
 

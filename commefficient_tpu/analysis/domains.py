@@ -40,6 +40,8 @@ DOMAINS = {
     "sampler": 0x5C4ED,    # scheduler/policy.ThroughputAwareSampler
     "poison": 0xBAD0D,     # utils/faults.poison_mask (value faults)
     "byzantine": 0xB42A1,  # utils/faults.byzantine_mask (adversaries)
+    "dp": 0xD9A05,         # compress/dp_sketch per-round Gaussian noise
+    "powersgd": 0x909D0,   # compress/powersgd fresh-client Q warm start
 }
 
 _values = list(DOMAINS.values())

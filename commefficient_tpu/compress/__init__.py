@@ -1,0 +1,85 @@
+"""compress/: the Compressor plugin registry (ISSUE 19).
+
+One plugin per ``Config.mode`` value. The engine resolves its plugin
+ONCE per traced-program family (``get_compressor(cfg.mode)``) and
+routes every mode-specific decision — wire geometry, client-state
+blocks, the four traced round seams, config invariants — through it.
+
+Import-order contract: this package may import ``config`` (for the
+MODES coverage assert below), and config's spec properties import
+THIS package lazily at property-call time — config never imports
+compress at module level, so there is no cycle. The plugin modules
+import ``federated.*`` lazily inside their ``decode`` hooks for the
+same reason (federated/__init__ pulls the whole engine, which imports
+config, which must already be importable).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from commefficient_tpu.compress.base import Compressor
+from commefficient_tpu.compress.dp_sketch import DpSketchCompressor
+from commefficient_tpu.compress.modes import (FedavgCompressor,
+                                              LocalTopkCompressor,
+                                              SketchCompressor,
+                                              TrueTopkCompressor,
+                                              UncompressedCompressor)
+from commefficient_tpu.compress.powersgd import PowerSGDCompressor
+from commefficient_tpu.compress.privacy import (RdpAccountant,
+                                                closed_form_epsilon)
+
+_REGISTRY: Dict[str, Compressor] = {}
+
+
+def register(comp: Compressor) -> Compressor:
+    """Register a plugin under ``comp.name``. Re-registering a name is
+    an error — plugins are process-global singletons."""
+    if not comp.name:
+        raise ValueError(f"{type(comp).__name__} has an empty name")
+    if comp.name in _REGISTRY:
+        raise ValueError(
+            f"compressor {comp.name!r} is already registered "
+            f"({type(_REGISTRY[comp.name]).__name__})")
+    _REGISTRY[comp.name] = comp
+    return comp
+
+
+def get_compressor(mode: str) -> Compressor:
+    """The plugin for a Config.mode value, raising loudly on unknown
+    names."""
+    try:
+        return _REGISTRY[mode]
+    except KeyError:
+        raise KeyError(
+            f"no compressor registered for mode {mode!r}; "
+            f"registered: {sorted(_REGISTRY)}") from None
+
+
+def registered_modes() -> tuple:
+    return tuple(sorted(_REGISTRY))
+
+
+for _comp in (SketchCompressor(), TrueTopkCompressor(),
+              LocalTopkCompressor(), FedavgCompressor(),
+              UncompressedCompressor(), PowerSGDCompressor(),
+              DpSketchCompressor()):
+    register(_comp)
+del _comp
+
+
+def _assert_covers_modes() -> None:
+    # every Config.mode has a plugin and every plugin is a mode —
+    # drift in either direction is a packaging bug, not a user error
+    from commefficient_tpu.config import MODES
+    if set(_REGISTRY) != set(MODES):
+        raise AssertionError(
+            f"compressor registry {sorted(_REGISTRY)} != config.MODES "
+            f"{sorted(MODES)}")
+
+
+_assert_covers_modes()
+
+__all__ = [
+    "Compressor", "RdpAccountant", "closed_form_epsilon",
+    "get_compressor", "register", "registered_modes",
+]

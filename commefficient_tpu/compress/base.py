@@ -1,0 +1,142 @@
+"""The Compressor plugin interface (ISSUE 19).
+
+Before this subsystem the five client->server update modes were
+hard-wired through ``federated/client.py`` / ``federated/server.py`` /
+``federated/round.py`` as inline ``cfg.mode == ...`` branches, and the
+accounting / audit / bench surfaces each re-derived the per-mode wire
+geometry by hand — adding a compression scheme was surgery across a
+dozen files. A ``Compressor`` packages everything the engine needs to
+know about one scheme:
+
+static specs (host-side, pure config math — what round.py uses to
+pre-allocate cohort operands and graftaudit/graftmesh use to trace the
+plugin's programs):
+
+  * ``state_shape(cfg)``   — shape of the server accumulator blocks
+                             (ServerState.Vvelocity / .Verror);
+  * ``wire_floats(cfg)``   — floats on the wire per participating
+                             client per round (the analytic payload);
+  * ``wire_bytes(cfg)``    — the BYTES the CommAccountant bills per
+                             client per round, at the realized wire
+                             dtype;
+  * ``has_errors(cfg)`` / ``has_velocities(cfg)`` — whether the
+    per-client [population, D] error / velocity blocks are tracked
+    (the PR-9 gather/scatter pair and checkpoint ``crows_*`` payloads
+    key off these);
+  * ``validate(cfg)``      — plugin-specific config invariants,
+    raising ``ValueError`` on combinations the plugin does not
+    compose with (Config.validate dispatches here).
+
+traced hooks (the four seams of the jitted round; every default
+implementation is the IDENTITY or a pure delegation, so the five
+classic plugins trace byte-identical programs to the pre-plugin
+engine):
+
+  * ``encode(cfg, grad, key)`` — per-client, inside forward_grad: the
+    mean gradient -> the wire-space quantity (sketch table for the
+    sketch-like plugins; dense pass-through otherwise);
+  * ``residual(cfg, to_transmit, error, velocity, key)`` — per-client,
+    at the end of local_step AFTER count scaling and error/momentum
+    accumulation: final wire payload + the error-feedback carry
+    (local_topk's sparsify-and-mask, PowerSGD's low-rank
+    factorization, dp_sketch's sensitivity clip live here);
+  * ``post_aggregate(cfg, transmit, round_key)`` — once per round on
+    the psum'd aggregate, before the divide-by-total (dp_sketch's
+    calibrated Gaussian noise lives here);
+  * ``decode(cfg, gradient, Vvelocity, Verror, lr, key)`` — the
+    server aggregation/decompression step -> ``ServerUpdate``.
+
+Class attributes route the engine's remaining static branches:
+``local_sgd`` (fedavg-style multi-step local training instead of one
+gradient step) and ``sketch_like`` (the wire quantity is an [r, c]
+count-sketch table).
+
+Registration: instantiate and pass to ``compress.register`` (the
+modules in this package do it at import). ``Config.validate`` rejects
+unregistered mode names, and the registry is asserted to cover
+exactly ``config.MODES``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+class Compressor:
+    """Base plugin: the identity/dense scheme every hook defaults to.
+
+    Subclasses override only the seams their scheme touches — every
+    hook left at the default adds ZERO operations to the traced round
+    programs, which is what keeps the five migrated classic modes
+    bit-identical to the pre-plugin engine.
+    """
+
+    #: registry key == Config.mode value
+    name: str = ""
+    #: fedavg-style: one_client runs the multi-step local-SGD path
+    #: (fedavg_step) instead of the single-gradient local_step, and
+    #: the straggler work fraction is a completed-steps budget rather
+    #: than an example-mask truncation
+    local_sgd: bool = False
+    #: the wire quantity is the [num_rows, num_cols] count-sketch
+    #: table (server state and aggregation live in table space)
+    sketch_like: bool = False
+
+    # ---- static specs (host-side config math) -------------------------
+    def state_shape(self, cfg) -> Tuple[int, ...]:
+        """Shape of the server accumulator blocks for this scheme."""
+        if self.sketch_like:
+            return (cfg.num_rows, cfg.num_cols)
+        return (cfg.grad_size,)
+
+    def wire_floats(self, cfg) -> int:
+        """Floats on the wire per participating client per round."""
+        raise NotImplementedError
+
+    def wire_bytes(self, cfg) -> int:
+        """Bytes the accountant bills per participating client per
+        round, at the realized wire dtype (f32 unless the plugin
+        quantizes its payload)."""
+        return 4 * self.wire_floats(cfg)
+
+    def has_errors(self, cfg) -> bool:
+        """Whether the per-client [population, D] error block is
+        tracked (gathered/scattered/checkpointed)."""
+        return cfg.error_type == "local"
+
+    def has_velocities(self, cfg) -> bool:
+        """Whether the per-client [population, D] velocity block is
+        tracked. PowerSGD repurposes it for the warm-started Q
+        factor, so this is a plugin decision, not just a momentum
+        check."""
+        return cfg.local_momentum > 0
+
+    def validate(self, cfg) -> None:
+        """Raise ValueError on config combinations this plugin does
+        not support. Called from Config.validate AFTER the generic
+        invariants, so plugins may assume a structurally sane
+        config."""
+
+    # ---- traced hooks (the four round seams) --------------------------
+    def encode(self, cfg, grad, key=None):
+        """forward_grad seam: the client's mean gradient -> the
+        wire-space quantity. Default: dense pass-through (zero traced
+        ops)."""
+        return grad
+
+    def residual(self, cfg, to_transmit, error, velocity, key=None):
+        """local_step seam, after count scaling and error/momentum
+        accumulation: returns (wire payload, new error carry, new
+        velocity carry). Default: transmit everything, carries
+        unchanged (zero traced ops)."""
+        return to_transmit, error, velocity
+
+    def post_aggregate(self, cfg, transmit, round_key):
+        """round_step seam: the psum'd aggregate before the
+        divide-by-total. Default: identity (zero traced ops)."""
+        return transmit
+
+    def decode(self, cfg, gradient, Vvelocity, Verror, lr, key=None):
+        """Server aggregation/decompression -> ServerUpdate
+        (federated/server.ServerUpdate). The classic plugins delegate
+        to the existing server helpers verbatim."""
+        raise NotImplementedError

@@ -1,0 +1,156 @@
+"""PowerSGD: rank-r power-iteration compression (ISSUE 19 plugin #1).
+
+Vogels et al., "PowerSGD: Practical Low-Rank Gradient Compression in
+Distributed Optimization" (PAPERS.md): reshape the flat [D] update
+into a near-square [m, n] matrix M, run ONE warm-started power
+iteration —
+
+    P = M @ Q_prev          # [m, r]
+    P_hat = orth(P)         # Gram-Schmidt orthonormalization
+    Q_new = M^T @ P_hat     # [n, r]
+
+— transmit the (m + n) * r factor floats, and carry the low-rank
+residual M - P_hat @ Q_new^T in the client's error-feedback
+accumulator. The warm-started Q_new is PER-CLIENT compressor state:
+it rides the existing [population, D] velocity block (validate()
+forces local_momentum == 0, so the block is free) through the PR-9
+cohort gather/scatter pair, the crows_* checkpoint payloads, and the
+screened/dropped keep-mask merge — which is exactly what makes
+screened == dropped and crash->resume bit-exactness hold for the Q
+state with zero new machinery.
+
+Adaptation to this engine's topology (every client's transmit is
+summed by ONE psum): the factorization is per-client and the client
+DECODES its own low-rank approximation to a dense [D] vector before
+the sum — the wire in a real deployment carries the (m+n)r factor
+floats, so that is what wire_floats/wire_bytes bill, precisely the
+convention local_topk already uses (k-sparse payload billed at k
+floats, transmitted dense in simulation). Aggregation-side the dense
+transmit composes unchanged with the PR-16 admission screen (finite +
+norm checks over a dense vector) and the PR-17 robust aggregators
+(order statistics over [N, D] client updates).
+
+Fresh clients (all-zero Q row) warm-start from a deterministic
+Gaussian init drawn on the registered "powersgd" PRNG domain folded
+into the per-client round key — deterministic in (seed, round,
+client), so replay and resume are bit-exact.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.compress.base import Compressor
+
+
+def factor_shape(d: int):
+    """The near-square [m, n] factorization shape for a flat [D]
+    update: n = isqrt(d), m = ceil(d / n) — m * n >= d >= n * n, so
+    m >= n and the rank bound is min(m, n) = n."""
+    n = max(1, math.isqrt(d))
+    m = -(-d // n)
+    return m, n
+
+
+def orthonormalize(P, eps=1e-8):
+    """Column-wise modified Gram-Schmidt with an eps-guarded norm
+    (rank is a small static constant, so the loop unrolls in the
+    trace). A degenerate column (zero after projection) comes out as
+    a tiny-norm direction instead of NaN — its contribution to
+    P_hat @ Q^T is then ~0, and the residual lands in error
+    feedback like any other compression loss."""
+    cols = []
+    for i in range(P.shape[1]):
+        c = P[:, i]
+        for q in cols:
+            c = c - jnp.dot(q, c) * q
+        c = c / jnp.maximum(jnp.linalg.norm(c), eps)
+        cols.append(c)
+    return jnp.stack(cols, axis=1)
+
+
+class PowerSGDCompressor(Compressor):
+    name = "powersgd"
+
+    # ---- static specs -------------------------------------------------
+    def state_shape(self, cfg):
+        # server state is dense [D]: the decoded aggregate rides plain
+        # virtual momentum, like local_topk's server side
+        return (cfg.grad_size,)
+
+    def wire_floats(self, cfg) -> int:
+        m, n = factor_shape(cfg.grad_size)
+        return (m + n) * cfg.powersgd_rank
+
+    def has_errors(self, cfg) -> bool:
+        return True   # validate() forces error_type == "local"
+
+    def has_velocities(self, cfg) -> bool:
+        return True   # the warm-started Q factor rides this block
+
+    def validate(self, cfg) -> None:
+        if cfg.powersgd_rank < 1:
+            raise ValueError(
+                f"powersgd_rank={cfg.powersgd_rank} must be >= 1")
+        if cfg.error_type != "local":
+            raise ValueError(
+                "powersgd requires --error_type local: the low-rank "
+                "residual M - P Q^T is per-client error feedback "
+                "(compress/powersgd.py)")
+        if cfg.local_momentum != 0:
+            raise ValueError(
+                "powersgd requires local_momentum == 0: the per-client "
+                "velocity block carries the warm-started Q factor "
+                "(compress/powersgd.py)")
+        if cfg.grad_size > 0:
+            m, n = factor_shape(cfg.grad_size)
+            if cfg.powersgd_rank > n:
+                raise ValueError(
+                    f"powersgd_rank={cfg.powersgd_rank} exceeds the "
+                    f"rank bound min(m, n)={n} of the "
+                    f"[{m}, {n}] factorization of grad_size="
+                    f"{cfg.grad_size}")
+
+    # ---- traced hooks -------------------------------------------------
+    def residual(self, cfg, to_transmit, error, velocity, key=None):
+        """to_transmit IS the error accumulator here (error_type ==
+        local, momentum off => local_step set error += g and
+        to_transmit = error): factor it, transmit the low-rank
+        approximation, keep the residual as the new error carry and
+        Q_new as the new velocity carry."""
+        from commefficient_tpu.analysis.domains import domain
+        D = cfg.grad_size
+        m, n = factor_shape(D)
+        r = cfg.powersgd_rank
+        M = jnp.pad(to_transmit, (0, m * n - D)).reshape(m, n)
+
+        q_flat = velocity[:n * r]
+        # warm start: a fresh client's Q row is all-zero; substitute a
+        # deterministic Gaussian init (registered "powersgd" domain on
+        # the per-client round key — bit-exact on replay/resume)
+        q_key = jax.random.fold_in(key, domain("powersgd"))
+        q_init = jax.random.normal(q_key, (n, r), jnp.float32)
+        fresh = jnp.sum(q_flat * q_flat) == 0
+        Q_prev = jnp.where(fresh, q_init, q_flat.reshape(n, r))
+
+        P_hat = orthonormalize(M @ Q_prev)          # [m, r]
+        Q_new = M.T @ P_hat                         # [n, r]
+        approx = (P_hat @ Q_new.T).reshape(-1)[:D]  # client-side decode
+
+        new_error = to_transmit - approx            # low-rank residual
+        new_velocity = jnp.zeros_like(velocity).at[:n * r].set(
+            Q_new.reshape(-1))
+        return approx, new_error, new_velocity
+
+    def decode(self, cfg, gradient, Vvelocity, Verror, lr, key=None):
+        # clients already decoded their factors to dense; the server
+        # side is plain dense virtual momentum (local_topk's server
+        # math over an already-compressed aggregate). Lazy import:
+        # federated/__init__ pulls the whole engine, and config's spec
+        # properties import this package.
+        from commefficient_tpu.federated.server import ServerUpdate
+        rho = cfg.virtual_momentum
+        Vvelocity = gradient + rho * Vvelocity
+        return ServerUpdate(Vvelocity * lr, Vvelocity, Verror, None)

@@ -16,7 +16,12 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
-MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
+# one Compressor plugin per mode (commefficient_tpu/compress): the
+# five reference modes plus the ISSUE-19 plugins — powersgd (rank-r
+# power-iteration factors) and dp_sketch (sketch transport under the
+# Gaussian mechanism with Rényi budget accounting)
+MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed",
+         "powersgd", "dp_sketch")
 ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
 SCREEN_MODES = ("off", "finite", "norm")
@@ -386,6 +391,24 @@ class Config:
     l2_norm_clip: float = 1.0
     noise_multiplier: float = 0.0
 
+    # --- Compressor plugin knobs (ISSUE 19, commefficient_tpu/compress)
+    # powersgd: rank of the per-client P/Q power-iteration factors —
+    # the wire carries (m + n) * rank floats for the near-square
+    # [m, n] factorization of the flat [grad_size] update
+    powersgd_rank: int = 2
+    # dp_sketch: the Gaussian mechanism on the sketch table. dp_clip
+    # is the per-client Frobenius sensitivity bound on the count-
+    # scaled table; dp_noise_mult the noise multiplier (noise std =
+    # dp_noise_mult * dp_clip on the AGGREGATE, once per round);
+    # dp_target_epsilon the fail-loud budget ceiling at dp_delta
+    # (0 = track epsilon in the journal but never fail). Epsilon is
+    # tracked by the Rényi accountant (compress/privacy.py) and
+    # journaled per round as `privacy` events.
+    dp_clip: float = 1.0
+    dp_noise_mult: float = 0.0
+    dp_target_epsilon: float = 0.0
+    dp_delta: float = 1e-5
+
     # round scheduling (commefficient_tpu/scheduler, ISSUE 5): the
     # telemetry substrate's consumer. `sampler` picks the participant
     # policy — "uniform" is BIT-IDENTICAL to the pre-scheduler draw
@@ -523,40 +546,39 @@ class Config:
         return dataclasses.replace(self, **kw)
 
     @property
+    def compressor(self):
+        """The registered Compressor plugin for this mode (ISSUE 19,
+        commefficient_tpu/compress). Lazy import: compress imports
+        this module for the MODES coverage assert, so the dependency
+        must point compress -> config at module level and
+        config -> compress only at property-call time."""
+        from commefficient_tpu.compress import get_compressor
+        return get_compressor(self.mode)
+
+    @property
     def state_shape(self) -> Tuple[int, ...]:
         """Shape of the transmitted/accumulated quantity for this mode
-        (reference: fed_aggregator.py:116-121,400-405)."""
-        if self.mode == "sketch":
-            return (self.num_rows, self.num_cols)
-        return (self.grad_size,)
+        (reference: fed_aggregator.py:116-121,400-405; delegated to
+        the mode's Compressor plugin)."""
+        return self.compressor.state_shape(self)
 
     @property
     def upload_floats(self) -> int:
         """Floats uploaded per participating client per round
-        (reference: fed_aggregator.py:291-299)."""
-        return {
-            "uncompressed": self.grad_size,
-            "true_topk": self.grad_size,
-            "local_topk": self.k,
-            "sketch": self.num_rows * self.num_cols,
-            "fedavg": self.grad_size,
-        }[self.mode]
+        (reference: fed_aggregator.py:291-299; delegated to the
+        mode's Compressor plugin)."""
+        return self.compressor.wire_floats(self)
 
     @property
     def upload_bytes(self) -> int:
         """Bytes uploaded per participating client per round AT THE
         WIRE DTYPE — the quantity the accountant bills and journals
-        (ISSUE 6 accounting satellite). For sketch mode this is the
-        [r, c] table at sketch_table_dtype's element size (plus int8's
-        per-row f32 scales); every other mode transmits f32, so it is
+        (ISSUE 6 accounting satellite; delegated to the mode's
+        Compressor plugin). For sketch mode this is the [r, c] table
+        at sketch_table_dtype's element size (plus int8's per-row f32
+        scales); every other plugin transmits f32, so it is
         4 x upload_floats exactly as before."""
-        if self.mode == "sketch":
-            from commefficient_tpu.ops.kernels.quant import (
-                wire_table_bytes,
-            )
-            return wire_table_bytes(self.num_rows, self.num_cols,
-                                    self.sketch_table_dtype)
-        return 4 * self.upload_floats
+        return self.compressor.wire_bytes(self)
 
     @property
     def defer_sketch_encode(self) -> bool:
@@ -967,6 +989,22 @@ class Config:
         if self.down_k > self.grad_size > 0:
             raise ValueError(
                 f"down_k={self.down_k} exceeds grad_size={self.grad_size}")
+        if self.dp_noise_mult != 0 and self.mode != "dp_sketch":
+            # fail loud rather than silently training noise-free: the
+            # flag names the dp_sketch Gaussian mechanism
+            raise ValueError(
+                "--dp_noise_mult calibrates the dp_sketch Gaussian "
+                f"mechanism and requires --mode dp_sketch (got "
+                f"{self.mode!r}; --dp/--noise_multiplier is the "
+                "separate per-gradient DP path)")
+        if self.dp_target_epsilon != 0 and self.mode != "dp_sketch":
+            raise ValueError(
+                "--dp_target_epsilon bounds the dp_sketch privacy "
+                "budget and requires --mode dp_sketch (got "
+                f"{self.mode!r})")
+        # plugin-specific invariants (ISSUE 19): each Compressor
+        # rejects the config combinations it does not compose with
+        self.compressor.validate(self)
         return self
 
 
@@ -1323,6 +1361,33 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--dp_mode", choices=list(DP_MODES), default="worker")
     p.add_argument("--l2_norm_clip", type=float, default=1.0)
     p.add_argument("--noise_multiplier", type=float, default=0.0)
+
+    p.add_argument("--powersgd_rank", type=int, default=2,
+                   help="with --mode powersgd: rank of the per-client "
+                        "P/Q power-iteration factors — the wire "
+                        "carries (m+n)*rank floats per client "
+                        "(compress/powersgd.py)")
+    p.add_argument("--dp_clip", type=float, default=1.0,
+                   help="with --mode dp_sketch: per-client Frobenius "
+                        "clip of the count-scaled sketch table — the "
+                        "sum query's l2 sensitivity bound "
+                        "(compress/dp_sketch.py)")
+    p.add_argument("--dp_noise_mult", type=float, default=0.0,
+                   help="with --mode dp_sketch: Gaussian noise "
+                        "multiplier — noise std dp_noise_mult*dp_clip "
+                        "added once per round to the aggregated table "
+                        "inside the jitted round")
+    p.add_argument("--dp_target_epsilon", type=float, default=0.0,
+                   help="with --mode dp_sketch: fail-loud privacy "
+                        "budget ceiling at --dp_delta; the Rényi "
+                        "accountant journals cumulative epsilon per "
+                        "round as `privacy` events and the run raises "
+                        "when the budget is exhausted (0 = track but "
+                        "never fail)")
+    p.add_argument("--dp_delta", type=float, default=1e-5,
+                   help="with --mode dp_sketch: the delta of the "
+                        "(epsilon, delta)-DP guarantee the accountant "
+                        "reports")
     return p
 
 

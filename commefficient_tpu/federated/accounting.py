@@ -139,14 +139,18 @@ class CommAccountant:
             self.upload_floats = cfg.grad_size - frozen_count
         # billed upload BYTES at the wire dtype (ISSUE 6 accounting
         # fix): a bf16/int8 sketch table must not be charged at f32
-        # element size. Every non-sketch mode transmits f32 so the
-        # byte count is 4 x floats exactly as before; sketch mode
-        # defers to Config.upload_bytes (table elements at
-        # sketch_table_dtype size + int8's per-row scales). These are
-        # the `up_bytes` the journal records (api.py -> telemetry).
-        self.upload_bytes = (float(cfg.upload_bytes)
-                             if cfg.mode == "sketch"
-                             else 4.0 * self.upload_floats)
+        # element size. Config.upload_bytes is the mode's Compressor
+        # plugin answering at its realized wire dtype (ISSUE 19);
+        # the frozen-count adjustment above overrides it for the
+        # dense modes whose payload genuinely shrinks (those all
+        # transmit f32, so bytes stay 4 x floats exactly as before).
+        # These are the `up_bytes` the journal records (api.py ->
+        # telemetry).
+        self.upload_bytes = (4.0 * self.upload_floats
+                             if frozen_count
+                             and cfg.mode in ("uncompressed",
+                                              "true_topk", "fedavg")
+                             else float(cfg.upload_bytes))
         # local_topk blowout observability (module docstring: the
         # upload charge stays the ANALYTIC k): ops/flat.py's
         # sampled_threshold_mask can select MORE than k on threshold

@@ -221,6 +221,16 @@ class FedModel:
             cfg, self.num_clients,
             frozen_count=(0 if grad_mask is None
                           else int((grad_mask == 0).sum())))
+        # differential privacy (ISSUE 19): the RDP accountant is
+        # stateless — cumulative epsilon is a pure function of the
+        # committed-round count, so a crash->resume re-derives the
+        # identical curve from the restored round counter (no extra
+        # checkpoint state, no drift).
+        self.privacy = None
+        if cfg.mode == "dp_sketch" and cfg.dp_noise_mult > 0:
+            from commefficient_tpu.compress import RdpAccountant
+            self.privacy = RdpAccountant(cfg.dp_noise_mult,
+                                         cfg.dp_delta)
         self._prev_change_words: Optional[np.ndarray] = None
         self._pack_bits = jax.jit(pack_change_bits)
         from jax.sharding import PartitionSpec as P
@@ -898,6 +908,41 @@ class FedModel:
                          else -1.0),
             n_contrib=int(stats[3]))
 
+    # -- compressor plugins + differential privacy (ISSUE 19) -------------
+    def _journal_compressor(self, round_idx: int,
+                            up_bytes: float) -> None:
+        """Journal one committed round's `compressor` event: the
+        mode's static per-client wire geometry plus the round's
+        accounted upload total — summarize() accumulates these into
+        the per-mode bytes-on-wire table."""
+        self.telemetry.journal_event(
+            "compressor", round=int(round_idx), mode=self.cfg.mode,
+            wire_bytes=float(self.cfg.upload_bytes),
+            up_bytes=round(float(up_bytes), 3))
+
+    def _journal_privacy(self, round_idx: int) -> None:
+        """Journal one committed round's `privacy` event (cumulative
+        epsilon over the rounds committed so far) and fail LOUDLY
+        once the budget is exhausted. The exhausted round is
+        journaled BEFORE the raise, so the journal records the
+        crossing a post-mortem needs."""
+        eps = float(self.privacy.epsilon(round_idx + 1))
+        if self.telemetry is not None:
+            self.telemetry.journal_event(
+                "privacy", round=int(round_idx),
+                epsilon=round(eps, 6),
+                sigma=float(self.cfg.dp_noise_mult),
+                clip=float(self.cfg.dp_clip),
+                delta=float(self.cfg.dp_delta))
+        target = float(self.cfg.dp_target_epsilon)
+        if target > 0 and eps > target:
+            raise RuntimeError(
+                f"privacy budget exhausted at round {round_idx}: "
+                f"cumulative epsilon {eps:.4f} exceeds "
+                f"--dp_target_epsilon {target:g} at delta "
+                f"{self.cfg.dp_delta:g}. Raise --dp_noise_mult, "
+                f"raise --dp_target_epsilon, or train fewer rounds.")
+
     def _observe_screening(self, round_idx: int, n_screened: int,
                            survivors) -> None:
         """Feed the adaptive-screen controller one committed round's
@@ -1311,6 +1356,15 @@ class FedModel:
         if self.screen_ctl is not None and n_screened is not None:
             self._observe_screening(this_round, n_screened,
                                     staged.survivors)
+        # compressor + privacy journaling (ISSUE 19): per committed
+        # round, after accounting so up_bytes is this round's billed
+        # total. _journal_privacy raises once the epsilon budget is
+        # exhausted — the round above fully committed, so the abort
+        # lands at the same clean boundary an injected crash does.
+        if self.telemetry is not None:
+            self._journal_compressor(this_round, upload.sum())
+        if self.privacy is not None:
+            self._journal_privacy(this_round)
 
         # telemetry, one-round lag (same discipline as the metric
         # return below): hand the session this round's DEVICE metric
@@ -1759,6 +1813,16 @@ class FedModel:
                         survivors=surv_n)
                     comm_rows.append(None)
                 self._prev_change_words = bits_host[n]
+                # compressor + privacy journaling (ISSUE 19) — same
+                # per-round events as the unscanned commit path; the
+                # budget raise lands after this round's accounting
+                # lag advanced, the boundary a resume expects
+                if (self.telemetry is not None
+                        and comm_rows[-1] is not None):
+                    self._journal_compressor(first + n,
+                                             comm_rows[-1][1])
+                if self.privacy is not None:
+                    self._journal_privacy(first + n)
 
         # span-boundary telemetry export: ONE explicit device_get of
         # the [N, M] metric rows + [N, W] example counts, after the

@@ -24,11 +24,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from commefficient_tpu import compress
 from commefficient_tpu.config import Config
-from commefficient_tpu.ops.flat import (
-    clip_to_l2, clip_table_to_l2, dp_noise, global_norm_clip, masked_topk,
-)
-from commefficient_tpu.ops.sketch import CSVec
+from commefficient_tpu.ops.flat import clip_to_l2, dp_noise, global_norm_clip
 
 # loss_fn contract (the workload callback, analogous to the reference's
 # compute_loss(model, batch, args) -> (loss, *metrics) at
@@ -215,27 +213,12 @@ def forward_grad(flat_grad_fn, weights: jax.Array, batch, mask: jax.Array,
         if grad_mask is not None:
             grad = grad * grad_mask  # DP noise lands only on live coords
 
-    # per-mode compression (reference fed_worker.py:311-335)
-    if cfg.mode == "sketch":
-        if cfg.defer_sketch_encode:
-            # linearity: the round engine encodes the per-shard client
-            # SUM once, instead of one table per client (Config
-            # property docstring; round.py shard_train)
-            g = grad
-        else:
-            sketch = CSVec(d=cfg.grad_size, c=cfg.num_cols,
-                           r=cfg.num_rows, num_blocks=cfg.num_blocks,
-                           seed=42, backend=cfg.kernel_backend)
-            table = sketch.encode(grad)
-            if cfg.max_grad_norm is not None:
-                table = clip_table_to_l2(
-                    table, sketch.l2estimate(table), cfg.max_grad_norm)
-            g = table
-    else:
-        # true_topk / local_topk / fedavg / uncompressed all transmit
-        # the dense gradient here; sparsification happens later
-        # (server for true_topk; local_step for local_topk)
-        g = grad
+    # per-mode compression (reference fed_worker.py:311-335), delegated
+    # to the mode's Compressor plugin (ISSUE 19): the sketch-like
+    # plugins encode the [r, c] table here; dense plugins pass the
+    # gradient through untouched (sparsification happens later —
+    # server for true_topk, the residual seam for local_topk/powersgd)
+    g = compress.get_compressor(cfg.mode).encode(cfg, grad, key)
 
     return g, loss, metrics, total
 
@@ -313,13 +296,12 @@ def local_step(flat_grad_fn, weights, batch, mask, error, velocity,
     else:
         to_transmit = velocity if cfg.local_momentum > 0 else g
 
-    if cfg.mode == "local_topk":
-        to_transmit = masked_topk(to_transmit, k=cfg.k)
-        not_sent = (to_transmit == 0).astype(g.dtype)
-        if cfg.error_type == "local":
-            error = error * not_sent           # error feedback
-        if cfg.local_momentum > 0:
-            velocity = velocity * not_sent     # momentum factor masking
+    # residual seam (ISSUE 19): the plugin turns the accumulated
+    # quantity into the final wire payload plus new error/velocity
+    # carries — local_topk's sparsify-and-mask, powersgd's low-rank
+    # factorization, dp_sketch's sensitivity clip; identity elsewhere
+    to_transmit, error, velocity = compress.get_compressor(
+        cfg.mode).residual(cfg, to_transmit, error, velocity, key)
 
     return ClientResult(to_transmit, error, velocity, loss, metrics, count)
 

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from commefficient_tpu.parallel.compat import pcast, shard_map
 
+from commefficient_tpu import compress
 from commefficient_tpu.config import Config
 from commefficient_tpu.federated import client as fclient
 from commefficient_tpu.federated import server as fserver
@@ -267,8 +268,8 @@ def init_client_state(cfg: Config, num_clients: int,
         def alloc(shape):
             return jnp.zeros(shape, jnp.float32)
 
-    errors = alloc((rows, D)) if cfg.error_type == "local" else empty()
-    velocities = (alloc((rows, D)) if cfg.local_momentum > 0
+    errors = alloc((rows, D)) if _has_errors(cfg) else empty()
+    velocities = (alloc((rows, D)) if _has_velocities(cfg)
                   else empty())
     if cfg.do_topk_down:
         assert ps_weights is not None
@@ -281,8 +282,17 @@ def init_client_state(cfg: Config, num_clients: int,
     return ClientState(errors, velocities, weights)
 
 
-def _has_errors(cfg): return cfg.error_type == "local"
-def _has_velocities(cfg): return cfg.local_momentum > 0
+# which per-client [population, D] state blocks the config tracks —
+# a plugin decision since ISSUE 19 (powersgd repurposes the velocity
+# block for its warm-started Q factor); the classic plugins answer
+# with the original error_type/local_momentum checks, so default
+# allocations are unchanged
+def _has_errors(cfg):
+    return compress.get_compressor(cfg.mode).has_errors(cfg)
+
+
+def _has_velocities(cfg):
+    return compress.get_compressor(cfg.mode).has_velocities(cfg)
 
 
 def client_state_rows(cfg: Config, num_clients: int) -> int:
@@ -494,6 +504,9 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
     # clients sharded over the `clients` axis only — further axes
     # (tensor-parallel `model`) don't divide the client population
     n_shards = mesh.shape["clients"]
+    # the mode's Compressor plugin (ISSUE 19) — static config,
+    # resolved once per traced-program family
+    comp = compress.get_compressor(cfg.mode)
 
     # ---------------- per-shard client phase ----------------------------
     def shard_train(ps_weights, data, mask, err_rows, vel_rows, w_rows,
@@ -544,7 +557,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         # own local gradient, not the cross-client sum).
         ps_weights = pcast(ps_weights, "clients", to="varying")
 
-        if work is not None and cfg.mode != "fedavg":
+        if work is not None and not comp.local_sgd:
             # completed-examples budget: keep each client's first
             # ceil(f * valid) valid examples (cumsum walks valid
             # examples in order, so padding rows stay excluded and a
@@ -567,7 +580,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             else:
                 weights = ps_weights
 
-            if cfg.mode == "fedavg":
+            if comp.local_sgd:
                 res = fclient.fedavg_step(
                     flat_grad, weights, cdata, cmask, cfg, lr, key,
                     grad_mask=grad_mask, work=cwork)
@@ -597,7 +610,7 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
             dummy = jnp.zeros_like(mask, shape=mask.shape[:1])
             new_err = new_vel = new_w_rows = dummy
         else:
-            if work is not None and cfg.mode == "fedavg":
+            if work is not None and comp.local_sgd:
                 results, new_w_rows = jax.vmap(one_client)(
                     data, mask, err_rows, vel_rows, w_rows, keys, work)
             else:
@@ -1168,6 +1181,12 @@ def make_train_fn(loss_fn: fclient.LossFn, unravel: Callable,
         # (ISSUE 17) already produced the NORMALIZED location estimate
         # inside shard_train (an order statistic does not distribute
         # over the psum/divide split), so the divide is skipped.
+        # compressor post-aggregation hook (ISSUE 19): once per round
+        # on the aggregate, before the divide — dp_sketch adds its
+        # calibrated Gaussian noise here, on the "dp" domain of the
+        # round key; the identity (zero traced ops) for every other
+        # plugin, so default programs are byte-unchanged
+        transmit = comp.post_aggregate(cfg, transmit, round_key)
         if cfg.robust_aggregation and pois is not None:
             gradient = transmit
         else:

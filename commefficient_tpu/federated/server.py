@@ -71,14 +71,11 @@ def get_server_update(gradient: jax.Array, Vvelocity: jax.Array,
     would still decay momentum (rho * V) and fold V into the error
     accumulator — state drift from a round in which no information
     arrived."""
-    helper = {
-        "sketch": _sketched,
-        "local_topk": _local_topk,
-        "true_topk": _true_topk,
-        "fedavg": _fedavg,
-        "uncompressed": _uncompressed,
-    }[cfg.mode]
-    upd = helper(gradient, Vvelocity, Verror, cfg, lr, key)
+    # dispatch through the mode's Compressor plugin (ISSUE 19); the
+    # five classic plugins delegate straight back to the helpers below
+    from commefficient_tpu import compress
+    upd = compress.get_compressor(cfg.mode).decode(
+        cfg, gradient, Vvelocity, Verror, lr, key)
     if alive is None:
         return upd
     return ServerUpdate(

@@ -125,6 +125,19 @@ consumers must tolerate kinds they don't know):
                           per-rule NU `rules` counts, per-program
                           `ulp` worst-case reassociation bounds, and
                           the finding count
+  privacy                 differential privacy (ISSUE 19, dp_sketch
+                          mode): one committed round's cumulative
+                          Rényi-DP budget — `round`, `epsilon`
+                          (cumulative; never decreases within a
+                          segment), `sigma` (noise multiplier),
+                          `clip` (per-client l2 bound), `delta`
+  compressor              one committed round's compressor billing
+                          (ISSUE 19, compress/ plugins): `round`,
+                          `mode`, `wire_bytes` (the plugin's static
+                          per-client wire geometry), `up_bytes` (the
+                          round's accounted upload total) —
+                          summarize() folds these into the per-mode
+                          bytes-on-wire table
 """
 from __future__ import annotations
 
@@ -525,6 +538,13 @@ def validate_journal(path: str,
       * `screen_adapt` events (ISSUE 17 adaptive screening) carry an
         integer `round` and numeric `old_mult`/`new_mult`/`rate`/
         `target`, with both multipliers positive;
+      * `privacy` events (ISSUE 19 differential privacy) carry an
+        integer `round`, a non-negative numeric `epsilon` that never
+        DECREASES within a run segment (the RDP budget only
+        accumulates), positive `sigma`/`clip`, and `delta` in (0, 1);
+      * `compressor` events (ISSUE 19 compressor plugins) carry an
+        integer `round`, a non-empty string `mode`, and non-negative
+        numeric `wire_bytes`/`up_bytes`;
       * `numeric_trip` events carry an integer `round` and a list of
         metric-name strings `metrics`; a trip also opens a new run
         SEGMENT (see below) — the driver rolls back and replays;
@@ -547,6 +567,7 @@ def validate_journal(path: str,
     seen_rounds = set()
     last_round = None
     seg_down = seg_up = 0.0
+    last_epsilon = None
 
     def _comm_field(rec, n, field):
         """Validate one byte-total field; returns its value or None."""
@@ -565,6 +586,7 @@ def validate_journal(path: str,
             seen_rounds = set()
             last_round = None
             seg_down = seg_up = 0.0
+            last_epsilon = None
         if rec.get("event") == "numeric_trip":
             # finite-frontier rollback (ISSUE 16): the driver walks
             # back to the newest finite checkpoint and REPLAYS rounds
@@ -573,8 +595,12 @@ def validate_journal(path: str,
             # Byte accumulation is NOT reset: the accountant keeps
             # counting across the rollback, so run_end totals still
             # cover every journaled per-round sum including replays.
+            # The epsilon tracker IS reset: epsilon is a pure function
+            # of the committed-round count, so replayed rounds
+            # legitimately re-journal the lower values of the window.
             seen_rounds = set()
             last_round = None
+            last_epsilon = None
         for field in REQUIRED_FIELDS:
             if field not in rec:
                 problems.append(f"record {n}: missing `{field}`")
@@ -705,6 +731,55 @@ def validate_journal(path: str,
                     problems.append(
                         f"record {n}: screen_adapt `{field}` must be "
                         f"a positive number (got {v2!r})")
+        if rec.get("event") == "privacy":
+            # differential privacy (ISSUE 19): the budget record the
+            # tier1 dp smoke's monotone-epsilon gate reads, so its
+            # shape — and the monotonicity itself — must not rot
+            if not isinstance(rec.get("round"), int):
+                problems.append(
+                    f"record {n}: privacy event without an integer "
+                    f"`round` (got {rec.get('round')!r})")
+            eps = rec.get("epsilon")
+            if not (isinstance(eps, (int, float)) and eps >= 0):
+                problems.append(
+                    f"record {n}: privacy `epsilon` must be a "
+                    f"non-negative number (got {eps!r})")
+            else:
+                if last_epsilon is not None and eps < last_epsilon:
+                    problems.append(
+                        f"record {n}: privacy `epsilon` decreased "
+                        f"({last_epsilon!r} -> {eps!r}) — the RDP "
+                        f"budget only accumulates within a segment")
+                last_epsilon = float(eps)
+            for field in ("sigma", "clip"):
+                v2 = rec.get(field)
+                if not (isinstance(v2, (int, float)) and v2 > 0):
+                    problems.append(
+                        f"record {n}: privacy `{field}` must be a "
+                        f"positive number (got {v2!r})")
+            d3 = rec.get("delta")
+            if not (isinstance(d3, (int, float)) and 0 < d3 < 1):
+                problems.append(
+                    f"record {n}: privacy `delta` must be in (0, 1) "
+                    f"(got {d3!r})")
+        if rec.get("event") == "compressor":
+            # compressor plugin billing (ISSUE 19): the per-mode
+            # bytes-on-wire record summarize() accumulates
+            if not isinstance(rec.get("round"), int):
+                problems.append(
+                    f"record {n}: compressor event without an integer "
+                    f"`round` (got {rec.get('round')!r})")
+            m2 = rec.get("mode")
+            if not (isinstance(m2, str) and m2):
+                problems.append(
+                    f"record {n}: compressor event without a "
+                    f"non-empty string `mode` (got {m2!r})")
+            for field in ("wire_bytes", "up_bytes"):
+                v2 = rec.get(field)
+                if not (isinstance(v2, (int, float)) and v2 >= 0):
+                    problems.append(
+                        f"record {n}: compressor `{field}` must be a "
+                        f"non-negative number (got {v2!r})")
         if rec.get("event") == "numeric_trip":
             if not isinstance(rec.get("round"), int):
                 problems.append(
@@ -902,6 +977,9 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     screened_total = 0
     trimmed_total = 0.0
     clipped_total = 0
+    epsilon_spent = None
+    privacy_sigma = privacy_delta = None
+    wire_by_mode: dict = {}
     # trace spans SEGMENTED at run_start: monotonic t0 values share a
     # base only within one process lifetime, so the wall-extent math
     # (overlap efficiency) must never mix segments from a resumed run
@@ -945,6 +1023,28 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
         if kind == "aggregator":
             trimmed_total += float(rec.get("n_trimmed", 0) or 0)
             clipped_total += int(rec.get("n_clipped", 0) or 0)
+        if kind == "privacy":
+            # cumulative by construction — the LAST record is the
+            # budget spent (a rollback's replay re-journals the lower
+            # window values, and the last one still wins)
+            eps = rec.get("epsilon")
+            if isinstance(eps, (int, float)):
+                epsilon_spent = float(eps)
+            if isinstance(rec.get("sigma"), (int, float)):
+                privacy_sigma = float(rec["sigma"])
+            if isinstance(rec.get("delta"), (int, float)):
+                privacy_delta = float(rec["delta"])
+        if kind == "compressor":
+            m2 = rec.get("mode")
+            ub = rec.get("up_bytes")
+            if isinstance(m2, str) and isinstance(ub, (int, float)):
+                acc = wire_by_mode.setdefault(
+                    m2, {"rounds": 0, "up_bytes": 0.0,
+                         "wire_bytes": 0.0})
+                acc["rounds"] += 1
+                acc["up_bytes"] += float(ub)
+                if isinstance(rec.get("wire_bytes"), (int, float)):
+                    acc["wire_bytes"] = float(rec["wire_bytes"])
         if kind == "state_tier":
             tier_hits += int(rec.get("hits", 0) or 0)
             tier_misses += int(rec.get("misses", 0) or 0)
@@ -996,6 +1096,22 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
         out["trimmed_total"] = round(trimmed_total, 3)
         out["clipped_total"] = clipped_total
         out["screen_adaptations"] = kinds.get("screen_adapt", 0)
+    if epsilon_spent is not None:
+        # differential privacy (ISSUE 19): cumulative budget spent —
+        # the one number a DP run is answerable for
+        out["epsilon_spent"] = round(epsilon_spent, 6)
+        if privacy_sigma is not None:
+            out["privacy_sigma"] = privacy_sigma
+        if privacy_delta is not None:
+            out["privacy_delta"] = privacy_delta
+    if wire_by_mode:
+        # compressor plugins (ISSUE 19): per-mode bytes-on-wire —
+        # round count, per-client wire geometry, cumulative upload
+        out["compressor_modes"] = {
+            m: {"rounds": acc["rounds"],
+                "wire_bytes": round(acc["wire_bytes"], 3),
+                "up_mib": round(acc["up_bytes"] / (1024 ** 2), 3)}
+            for m, acc in sorted(wire_by_mode.items())}
     if tier_hits or tier_misses:
         # tiered client state (ISSUE 11): working-set hit rate +
         # spill traffic — the run's residency summary line

@@ -451,5 +451,77 @@ spills = sum(json.loads(l).get("spills", 0)
              if '"state_tier"' in l)
 assert spills > 0, "tiered smoke journaled zero spills"
 PYEOF
+
+  # PowerSGD compressor smoke (ISSUE 19): the telemetry smoke's config
+  # on the rank-2 low-rank plugin (local error feedback, warm-started
+  # Q factors in the velocities block). Gates: the journal validates
+  # (compressor event schema) and every round journals a compressor
+  # event with the factor-wire byte total — a plugin that bills the
+  # dense gradient instead of (m+n)*rank factors fails here.
+  JR10=/tmp/_t1_journal_psgd.jsonl
+  rm -f "$JR10"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode powersgd \
+      --powersgd_rank 2 --error_type local --local_momentum 0.0 \
+      --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --journal_path "$JR10" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "POWERSGD_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR10" \
+      || { echo "POWERSGD_JOURNAL_INVALID"; exit 1; }
+  python - "$JR10" <<'PYEOF' || { echo "POWERSGD_GATE_FAILED"; exit 1; }
+import json, sys
+evs = [json.loads(l) for l in open(sys.argv[1]) if '"compressor"' in l]
+evs = [e for e in evs if e.get("event") == "compressor"]
+assert evs, "powersgd smoke journaled no compressor events"
+assert all(e["mode"] == "powersgd" for e in evs), evs[:3]
+assert all(e["wire_bytes"] > 0 for e in evs), evs[:3]
+print(f"POWERSGD_GATE_OK rounds={len(evs)} "
+      f"wire_bytes={evs[0]['wire_bytes']}")
+PYEOF
+
+  # DP-sketch compressor smoke (ISSUE 19): the sketch smoke's geometry
+  # with per-client l2 clipping and calibrated Gaussian noise on the
+  # registered "dp" PRNG domain, under a live --dp_target_epsilon
+  # budget. Gates: the journal validates (privacy event schema), every
+  # committed round journals a privacy event, the cumulative epsilon
+  # trajectory is non-decreasing and stays under the budget the run
+  # was given (sigma is sized so the smoke cannot exhaust it), and
+  # summarize() surfaces the spend.
+  JR11=/tmp/_t1_journal_dp.jsonl
+  rm -f "$JR11"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode dp_sketch \
+      --error_type virtual --virtual_momentum 0.9 \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.05 --valid_batch_size 16 --lr_scale 0.1 \
+      --k 64 --num_rows 3 --num_cols 256 --num_blocks 1 \
+      --dp_clip 1.0 --dp_noise_mult 4.0 --dp_target_epsilon 8 \
+      --journal_path "$JR11" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "DP_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR11" \
+      || { echo "DP_JOURNAL_INVALID"; exit 1; }
+  python - "$JR11" <<'PYEOF' || { echo "DP_GATE_FAILED"; exit 1; }
+import json, sys
+sys.path.insert(0, ".")
+from commefficient_tpu.telemetry.journal import summarize, validate_journal
+records, problems = validate_journal(sys.argv[1])
+assert not problems, problems
+evs = [r for r in records if r.get("event") == "privacy"]
+assert evs, "dp_sketch smoke journaled no privacy events"
+eps = [e["epsilon"] for e in evs]
+assert all(b >= a for a, b in zip(eps, eps[1:])), \
+    f"epsilon trajectory not monotone: {eps}"
+assert eps[-1] <= 8.0, f"smoke exceeded its own budget: {eps[-1]}"
+s = summarize(records)
+assert s.get("epsilon_spent") == eps[-1], s.get("epsilon_spent")
+assert "dp_sketch" in s.get("compressor_modes", {}), \
+    s.get("compressor_modes")
+print(f"DP_GATE_OK rounds={len(evs)} epsilon_spent={eps[-1]}")
+PYEOF
 fi
 exit $rc

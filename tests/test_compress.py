@@ -1,0 +1,468 @@
+"""Compressor plugin subsystem drills (ISSUE 19).
+
+The tentpole's executable claims:
+
+  * the registry covers exactly Config.MODES, and the five classic
+    modes' compressor specs (state shape, wire floats/bytes) match the
+    closed forms the engine used before the plugin seam existed;
+  * PowerSGD's Gram-Schmidt is orthonormal on full-rank input and
+    finite on rank-deficient input; its warm-started Q factors live in
+    the velocities block and survive a crash->resume bit-exactly;
+  * a screened client IS a dropped client for BOTH new plugins —
+    poisoning slots under update_screen=finite lands the identical
+    bits (server + client state, per-round bytes) as scripting the
+    same slots as dropouts;
+  * crash-after-round-k + resume-from-latest reproduces the
+    uninterrupted run bit-identically for powersgd (warm Q included)
+    and dp_sketch (the noise stream is keyed to the round counter);
+  * the RDP accountant's grid-minimized epsilon tracks the
+    closed-form Gaussian-composition reference from above, is
+    monotone in rounds, and the journaled `privacy` events reproduce
+    it exactly (stateless: epsilon is a pure function of the round
+    count);
+  * each plugin family compiles exactly its own programs — gather +
+    scatter + one round variant on first dispatch, zero retraces in
+    steady state;
+  * Config.validate() rejects the documented bad compositions loudly
+    (powersgd without local error feedback, dp_sketch stacked on
+    do_dp or robust aggregation, DP flags on non-DP modes).
+"""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.compress
+
+from commefficient_tpu import compress
+from commefficient_tpu.compress import (
+    RdpAccountant, closed_form_epsilon, get_compressor, registered_modes,
+)
+from commefficient_tpu.compress.powersgd import (
+    factor_shape, orthonormalize,
+)
+from commefficient_tpu.config import MODES, Config
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.federated.round import (
+    program_variants_for, screened_family,
+)
+from commefficient_tpu.telemetry import RunJournal, TelemetrySession
+from commefficient_tpu.telemetry.journal import summarize, validate_journal
+from commefficient_tpu.utils.checkpoint import load_latest, save_rotating
+from commefficient_tpu.utils.faults import FaultSchedule, InjectedFault
+
+D = 8
+W = 8
+B = 4
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+def _problem(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(W, B, D).astype(np.float32)
+    y = rng.randn(W, B).astype(np.float32)
+    return x, y
+
+
+def _fed_model(mode, **kw):
+    base = dict(mode=mode, grad_size=D, weight_decay=0.0, num_workers=W,
+                local_momentum=0.0, virtual_momentum=0.0,
+                error_type="none", microbatch_size=-1, num_clients=W)
+    base.update(kw)
+    model = FedModel(None, loss_fn, Config(**base).validate(),
+                     params={"w": jnp.zeros(D)})
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+def _run_rounds(model, opt, rounds, data, start=0):
+    x, y = data
+    ids = np.arange(W, dtype=np.int32)
+    mask = np.ones((W, B), np.float32)
+    for _ in range(start, rounds):
+        model((ids, (x, y), mask))
+        opt.step()
+
+
+def _state_arrays(model):
+    return {
+        "ps_weights": np.asarray(model.server.ps_weights),
+        "Vvelocity": np.asarray(model.server.Vvelocity),
+        "Verror": np.asarray(model.server.Verror),
+        "round_idx": np.asarray(model.server.round_idx),
+        "errors": np.asarray(model.clients.errors),
+        "velocities": np.asarray(model.clients.velocities),
+    }
+
+
+# the two new plugin configs exercised across the contract drills
+POWERSGD_KW = dict(error_type="local", powersgd_rank=2)
+DP_KW = dict(k=D, num_rows=2, num_cols=64, num_blocks=1,
+             dp_clip=1.0, dp_noise_mult=1.0)
+PLUGIN_MODES = [("powersgd", POWERSGD_KW), ("dp_sketch", DP_KW)]
+
+
+# ---------------- registry + spec parity ----------------------------------
+
+def test_registry_covers_modes():
+    assert set(registered_modes()) == set(MODES)
+    with pytest.raises(KeyError):
+        get_compressor("no_such_mode")
+
+
+def test_classic_spec_parity():
+    """The five pre-plugin modes' compressor specs reproduce the
+    closed forms config.py used before the plugin seam: state shape,
+    wire floats, and wire bytes = 4 x floats (f32 wire)."""
+    base = dict(grad_size=D, num_workers=W, num_clients=W,
+                weight_decay=0.0, microbatch_size=-1,
+                local_momentum=0.0)
+    cases = [
+        ("sketch", dict(k=4, num_rows=3, num_cols=16, num_blocks=1,
+                        error_type="virtual"), (3, 16), 3 * 16),
+        ("true_topk", dict(k=3, error_type="virtual"), (D,), D),
+        ("local_topk", dict(k=3, error_type="local"), (D,), 3),
+        ("fedavg", dict(local_batch_size=-1, fedavg_batch_size=2),
+         (D,), D),
+        ("uncompressed", {}, (D,), D),
+    ]
+    for mode, kw, want_shape, want_floats in cases:
+        cfg = Config(mode=mode, **base, **kw).validate()
+        comp = cfg.compressor
+        assert comp.name == mode
+        assert comp.state_shape(cfg) == want_shape, mode
+        assert cfg.state_shape == want_shape, mode
+        assert cfg.upload_floats == want_floats, mode
+        assert cfg.upload_bytes == 4 * want_floats or mode == "sketch"
+    # sketch wire bytes follow the table transport dtype, not a
+    # hard-coded 4x (the bf16/int8 transport arm prices differently)
+    cfg = Config(mode="sketch", k=4, num_rows=3, num_cols=16,
+                 num_blocks=1, error_type="virtual", **base).validate()
+    assert cfg.upload_bytes == cfg.compressor.wire_bytes(cfg)
+
+
+def test_plugin_wire_geometry():
+    """powersgd ships (m+n)*rank floats (the P/Q factors); dp_sketch
+    ships the full [rows, cols] table in f32."""
+    base = dict(grad_size=1000, num_workers=W, num_clients=W,
+                weight_decay=0.0, microbatch_size=-1)
+    cfg = Config(mode="powersgd", local_momentum=0.0,
+                 **POWERSGD_KW, **base).validate()
+    m, n = factor_shape(1000)
+    assert m * n >= 1000 and (m - 1) * n < 1000
+    assert cfg.upload_floats == (m + n) * 2
+    assert cfg.upload_bytes == 4 * (m + n) * 2
+    cfg = Config(mode="dp_sketch", error_type="none",
+                 local_momentum=0.0, **DP_KW, **base).validate()
+    assert cfg.upload_floats == 2 * 64
+    assert cfg.upload_bytes == 4 * 2 * 64
+
+
+# ---------------- Gram-Schmidt --------------------------------------------
+
+def test_gram_schmidt_orthonormal():
+    rng = np.random.RandomState(3)
+    P = jnp.asarray(rng.randn(32, 4).astype(np.float32))
+    Q = orthonormalize(P)
+    np.testing.assert_allclose(np.asarray(Q.T @ Q), np.eye(4),
+                               atol=1e-5)
+    # spans the same subspace: projecting P onto Q loses nothing
+    np.testing.assert_allclose(np.asarray(Q @ (Q.T @ P)),
+                               np.asarray(P), atol=1e-4)
+
+
+def test_gram_schmidt_rank_deficient_is_finite():
+    """Duplicate columns (rank < r) must not divide by a ~zero norm:
+    the eps guard keeps every entry finite."""
+    rng = np.random.RandomState(4)
+    col = rng.randn(16, 1).astype(np.float32)
+    P = jnp.asarray(np.concatenate([col, col, 0.0 * col], axis=1))
+    Q = orthonormalize(P)
+    assert bool(jnp.isfinite(Q).all())
+
+
+# ---------------- training smoke + warm Q ---------------------------------
+
+def test_powersgd_trains_and_warms_q():
+    """Three rounds of powersgd reduce the loss, leave the EF residual
+    in the errors block, and warm-start Q in the velocities block for
+    every participating client."""
+    model, opt = _fed_model("powersgd", **POWERSGD_KW)
+    data = _problem(seed=2)
+    x, y = data
+    ids = np.arange(W, dtype=np.int32)
+    mask = np.ones((W, B), np.float32)
+    first = float(np.asarray(model((ids, (x, y), mask))[0]).mean())
+    opt.step()
+    for _ in range(4):
+        out = model((ids, (x, y), mask))
+        opt.step()
+    last = float(np.asarray(out[0]).mean())
+    assert last < first
+    m, n = factor_shape(D)
+    vel = np.asarray(model.clients.velocities)
+    # every client's warm-Q slot [0, n*rank) is populated, the rest of
+    # the row stays zero (the factor parking contract)
+    assert (np.abs(vel[:, :n * 2]).sum(axis=1) > 0).all()
+    assert np.abs(vel[:, n * 2:]).sum() == 0
+    assert np.abs(np.asarray(model.clients.errors)).sum() > 0
+
+
+def test_dp_sketch_replay_deterministic():
+    """Two fresh runs with the same seed land bit-identical state: the
+    noise stream is a pure function of (seed, round), not of host
+    entropy."""
+    data = _problem(seed=5)
+    model_a, opt_a = _fed_model("dp_sketch", **DP_KW)
+    _run_rounds(model_a, opt_a, 3, data)
+    model_b, opt_b = _fed_model("dp_sketch", **DP_KW)
+    _run_rounds(model_b, opt_b, 3, data)
+    want, got = _state_arrays(model_a), _state_arrays(model_b)
+    for name in want:
+        np.testing.assert_array_equal(got[name], want[name],
+                                      err_msg=name)
+
+
+# ---------------- screened == dropped -------------------------------------
+
+@pytest.mark.parametrize("mode,extra", PLUGIN_MODES,
+                         ids=[m for m, _ in PLUGIN_MODES])
+def test_screened_matches_dropped(mode, extra):
+    """Poisoning slots {2,5}@r1 and {0}@r3 under update_screen=finite
+    lands the IDENTICAL bits — server state, client rows (powersgd's
+    warm Q included), per-round byte totals — as scripting the same
+    slots as dropouts. The PR-16 admission contract, per plugin."""
+    R = 5
+    slots = {1: [2, 5], 3: [0]}
+    data = _problem(seed=9)
+
+    model_p, opt_p = _fed_model(mode, update_screen="finite",
+                                poison_kind="nan", **extra)
+    assert screened_family(model_p.cfg)
+    model_p.set_fault_schedule(FaultSchedule(poison=slots))
+    model_d, opt_d = _fed_model(mode, **extra)
+    model_d.set_fault_schedule(FaultSchedule(drop_slots=slots))
+
+    ids = np.arange(W, dtype=np.int32)
+    x, y = data
+    mask = np.ones((W, B), np.float32)
+    for r in range(R):
+        _, _, down_p, up_p = model_p((ids, (x, y), mask))
+        opt_p.step()
+        _, _, down_d, up_d = model_d((ids, (x, y), mask))
+        opt_d.step()
+        np.testing.assert_array_equal(
+            np.asarray(up_p), np.asarray(up_d),
+            err_msg=f"{mode} round {r}: upload bytes")
+        for s in slots.get(r, ()):
+            assert float(np.asarray(up_p)[s]) == 0.0, \
+                f"{mode} round {r}: screened slot {s} still uploaded"
+
+    want, got = _state_arrays(model_d), _state_arrays(model_p)
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"{mode}: {name}: screened-out != dropped-out")
+
+
+# ---------------- crash -> resume bit-exactness ---------------------------
+
+@pytest.mark.parametrize("mode,extra", PLUGIN_MODES,
+                         ids=[m for m, _ in PLUGIN_MODES])
+def test_crash_resume_bit_identical(mode, extra, tmp_path):
+    """R rounds straight vs. crash-after-round-K + resume-from-latest:
+    bit-identical final state. For powersgd the checkpoint carries the
+    warm Q factors (velocities block) mid-warm; for dp_sketch the
+    resumed noise stream re-keys off the restored round counter."""
+    R, K = 6, 3
+    data = _problem(seed=5)
+    common = dict(client_dropout=0.25, **extra)
+
+    model_a, opt_a = _fed_model(mode, **common)
+    _run_rounds(model_a, opt_a, R, data)
+    want = _state_arrays(model_a)
+
+    prefix = os.path.join(str(tmp_path), mode)
+    model_b, opt_b = _fed_model(mode, **common)
+    model_b.set_fault_schedule(FaultSchedule(crash_after=K))
+    x, y = data
+    ids = np.arange(W, dtype=np.int32)
+    mask = np.ones((W, B), np.float32)
+    with pytest.raises(InjectedFault) as exc:
+        for _ in range(R):
+            model_b((ids, (x, y), mask))
+            opt_b.step()
+            save_rotating(prefix, model_b.server, model_b.clients,
+                          keep_last=2,
+                          fingerprint=model_b.checkpoint_fingerprint)
+    assert exc.value.round_idx == K
+
+    model_c, opt_c = _fed_model(mode, **common)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None
+    model_c.load_state(ckpt)
+    resumed_at = int(np.asarray(ckpt.server.round_idx))
+    assert resumed_at == K
+    if mode == "powersgd":
+        # the checkpoint really carried warm factors, not zeros
+        assert np.abs(np.asarray(model_c.clients.velocities)).sum() > 0
+    _run_rounds(model_c, opt_c, R, data, start=resumed_at)
+
+    got = _state_arrays(model_c)
+    for name in want:
+        np.testing.assert_array_equal(
+            got[name], want[name],
+            err_msg=f"{mode}: {name} diverged across crash->resume")
+
+
+# ---------------- RDP accountant ------------------------------------------
+
+def test_rdp_accountant_vs_closed_form():
+    """The grid-minimized epsilon hugs the closed-form Gaussian-
+    composition reference from ABOVE (the grid can only lose to the
+    continuous optimum) and within 1% of it; epsilon is monotone in
+    rounds and zero at zero rounds."""
+    for sigma, delta in ((1.0, 1e-5), (2.0, 1e-6), (0.7, 1e-5)):
+        acc = RdpAccountant(sigma, delta)
+        assert acc.epsilon(0) == 0.0
+        prev = 0.0
+        for steps in (1, 10, 100, 1000):
+            eps = acc.epsilon(steps)
+            ref = closed_form_epsilon(sigma, delta, steps)
+            assert eps >= ref - 1e-9, (sigma, steps)
+            assert eps <= ref * 1.01, (sigma, steps)
+            assert eps > prev
+            prev = eps
+
+
+def test_rdp_accountant_rejects_bad_params():
+    with pytest.raises(ValueError):
+        RdpAccountant(0.0, 1e-5)
+    with pytest.raises(ValueError):
+        RdpAccountant(1.0, 0.0)
+    with pytest.raises(ValueError):
+        RdpAccountant(1.0, 1.0)
+
+
+def test_privacy_journal_and_budget(tmp_path):
+    """A DP run journals one monotone `privacy` event and one
+    `compressor` event per round, the journal validates, summarize()
+    surfaces epsilon_spent + per-mode wire bytes, and the journaled
+    epsilons equal the stateless accountant's curve exactly. A tiny
+    budget raises RuntimeError naming the flags, AFTER journaling the
+    exhausted round."""
+    R = 4
+    data = _problem(seed=7)
+    model, opt = _fed_model("dp_sketch", telemetry=True,
+                            dp_target_epsilon=50.0, **DP_KW)
+    jr = str(tmp_path / "dp.jsonl")
+    tele = TelemetrySession(journal=RunJournal(jr))
+    model.attach_telemetry(tele)
+    _run_rounds(model, opt, R, data)
+    tele.close(ok=True)
+
+    recs, problems = validate_journal(jr)
+    assert not problems, problems
+    priv = [r for r in recs if r.get("event") == "privacy"]
+    comp = [r for r in recs if r.get("event") == "compressor"]
+    assert len(priv) == R and len(comp) == R
+    acc = RdpAccountant(DP_KW["dp_noise_mult"], model.cfg.dp_delta)
+    for e in priv:
+        assert e["epsilon"] == round(acc.epsilon(e["round"] + 1), 6)
+    eps = [e["epsilon"] for e in priv]
+    assert eps == sorted(eps)
+    assert all(c["mode"] == "dp_sketch" for c in comp)
+    assert all(c["wire_bytes"] == model.cfg.upload_bytes
+               for c in comp)
+    s = summarize(recs)
+    assert s["epsilon_spent"] == eps[-1]
+    assert s["compressor_modes"]["dp_sketch"]["rounds"] == R
+
+    # budget exhaustion: first round already exceeds 0.5
+    model2, opt2 = _fed_model("dp_sketch", dp_target_epsilon=0.5,
+                              **DP_KW)
+    with pytest.raises(RuntimeError, match="dp_target_epsilon"):
+        _run_rounds(model2, opt2, 2, data)
+
+
+# ---------------- program-count pins --------------------------------------
+
+@pytest.mark.parametrize("mode,extra", PLUGIN_MODES,
+                         ids=[m for m, _ in PLUGIN_MODES])
+def test_plugin_program_count_pins(mode, extra, sanitize):
+    """Each plugin family compiles exactly its own programs: gather +
+    scatter + mask_free on first dispatch, +1 for the dropout variant,
+    zero retraces afterwards — per-round noise/factor values are data,
+    never a trace."""
+    model, opt = _fed_model(mode, **extra)
+    assert program_variants_for(model.cfg) == \
+        ("mask_free", "dropout", "dropout_stragglers")
+    data = _problem(seed=2)
+    x, y = data
+    ids = np.arange(W, dtype=np.int32)
+    mask = np.ones((W, B), np.float32)
+
+    with sanitize.assert_program_count(3):
+        model((ids, (x, y), mask))
+        opt.step()
+    model.set_fault_schedule(
+        FaultSchedule(drop_slots={1: [3]}))
+    with sanitize.assert_program_count(1):  # dropout variant
+        model((ids, (x, y), mask))
+        opt.step()
+    with sanitize.assert_program_count(0):
+        for _ in range(3):
+            model((ids, (x, y), mask))
+            opt.step()
+
+
+# ---------------- validate() rejections -----------------------------------
+
+def test_validate_rejections():
+    base = dict(grad_size=D, num_workers=W, num_clients=W,
+                weight_decay=0.0, microbatch_size=-1,
+                local_momentum=0.0)
+    # powersgd needs local error feedback and no local momentum
+    with pytest.raises(ValueError):
+        Config(mode="powersgd", error_type="none", **base).validate()
+    with pytest.raises(ValueError):
+        Config(mode="powersgd", error_type="local",
+               **{**base, "local_momentum": 0.5}).validate()
+    with pytest.raises(ValueError):
+        Config(mode="powersgd", error_type="local", powersgd_rank=0,
+               **base).validate()
+    # dp_sketch needs calibrated noise and rejects double-DP / robust
+    # aggregation (order statistics break the sum's sensitivity bound)
+    with pytest.raises(ValueError):
+        Config(mode="dp_sketch", error_type="none",
+               k=D, num_rows=2, num_cols=64, num_blocks=1,
+               dp_noise_mult=0.0, **base).validate()
+    with pytest.raises(ValueError):
+        Config(mode="dp_sketch", error_type="none",
+               do_dp=True, dp_mode="server", noise_multiplier=0.1,
+               **DP_KW, **base).validate()
+    with pytest.raises(ValueError):
+        Config(mode="dp_sketch", error_type="none",
+               aggregator="trimmed_mean", **DP_KW, **base).validate()
+    # DP flags are dp_sketch-only
+    with pytest.raises(ValueError):
+        Config(mode="sketch", k=4, num_rows=2, num_cols=64,
+               num_blocks=1, error_type="virtual", dp_noise_mult=1.0,
+               **base).validate()
+    with pytest.raises(ValueError):
+        Config(mode="uncompressed", dp_target_epsilon=8.0,
+               **base).validate()

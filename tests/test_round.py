@@ -377,7 +377,7 @@ def test_error_feedback_absorbs_approximate_topk(mesh, monkeypatch):
     under local_topk + local error must still converge to the same
     loss regime as the exact path — the hardware-independent version
     of the TPU recall test."""
-    from commefficient_tpu.federated import client as fclient
+    from commefficient_tpu.compress import modes as cmodes
     from commefficient_tpu.ops.flat import masked_topk
 
     def lossy_topk(vec, k):
@@ -395,7 +395,9 @@ def test_error_feedback_absorbs_approximate_topk(mesh, monkeypatch):
         return drop_1d(exact) if exact.ndim == 1 else jax.vmap(drop_1d)(exact)
 
     def run(selector):
-        monkeypatch.setattr(fclient, "masked_topk", selector)
+        # the topk selection moved into the local_topk Compressor
+        # plugin (ISSUE 19) — patch the seam where it now lives
+        monkeypatch.setattr(cmodes, "masked_topk", selector)
         cfg, train_round, _, server, clients = setup(
             mesh, "local_topk", error_type="local", local_momentum=0.0,
             k=max(D // 2, 2), num_clients=8)
