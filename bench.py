@@ -943,6 +943,170 @@ def pipeline_main() -> int:
     return 0
 
 
+def control_main() -> int:
+    """ISSUE 20 self-tuning control sweep: per-round cadence under a
+    heavy straggler load (straggler_rate 0.6), static scan_span=1 vs
+    the adaptive span palette (1,2,4) with all three feedback
+    controllers live — cohort speed matching, adaptive span cadence,
+    and adaptive staleness decay — on the REAL scanned staging loop
+    with the full per-span persistence load armed (journal fsyncs +
+    rotated checkpoints), because amortizing that host work over
+    bigger spans is exactly the lever the cadence controller tunes.
+
+    Both arms drive the identical throughput-sampled stream through
+    the pipelined engine; the metric is the p50/p95 of the JOURNAL's
+    per-round `seconds` (the span wall amortized per round — rounds
+    inside one scanned span share a collect stamp, so raw inter-event
+    gaps would be bursty, not a cadence), warmup rounds dropped.
+    Reported: p50/p95 per-round seconds per arm, `vs_static` =
+    adaptive p95 / static p95 (< 1.0 = the controllers shortened the
+    straggler-dominated tail), and the per-controller journaled
+    adjustment counts — an inert controller fails the run. In-process
+    and CPU-friendly; invoked via BENCH_CONTROL=1 or
+    `python bench.py --control`. Lands in BENCH_r20.json."""
+    import tempfile
+
+    import numpy as np
+
+    with alarm_guard(INIT_TIMEOUT, "backend init"):
+        import jax
+        import jax.numpy as jnp
+        platform = jax.devices()[0].platform
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.data.sampler import FedSampler
+    from commefficient_tpu.federated.api import FedModel, FedOptimizer
+    from commefficient_tpu.scheduler import RoundScheduler
+    from commefficient_tpu.telemetry import TelemetrySession
+    from commefficient_tpu.telemetry.journal import (
+        RunJournal, summarize, validate_journal,
+    )
+    from commefficient_tpu.training.scanloop import (
+        make_span_checkpoint, run_scanned_rounds,
+    )
+    from commefficient_tpu.utils.schedules import LambdaLR
+
+    Dc = int(os.environ.get("BENCH_CONTROL_D", "32768"))
+    Wc, Bc, NCc = 8, 32, 16
+    ROUNDS_C = int(os.environ.get("BENCH_CONTROL_ROUNDS", "48"))
+    WARMUP = 8
+    log(f"self-tuning control sweep on {platform} "
+        f"(D={Dc}, {ROUNDS_C} rounds, straggler_rate=0.6)")
+
+    def loss_fn(params, batch, mask):
+        x, y = batch
+        pred = x @ params["w"]
+        per_ex = 0.5 * (pred - y) ** 2
+        loss = (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, (loss,)
+
+    LR = 1e-4
+    rng = np.random.RandomState(0)
+    x = rng.randn(NCc, Bc, Dc).astype(np.float32)
+    y = rng.randn(NCc, Bc).astype(np.float32)
+
+    def run_arm(adaptive: bool, workdir: str) -> dict:
+        knobs = (dict(scan_span_palette="1,2,4", speed_match=True,
+                      adapt_staleness=True)
+                 if adaptive else dict(scan_span=1))
+        cfg = Config(
+            mode="uncompressed", error_type="none", local_momentum=0.0,
+            virtual_momentum=0.9, grad_size=Dc, weight_decay=0.0,
+            num_workers=Wc, microbatch_size=-1, num_clients=NCc,
+            sampler="throughput", async_admit_rounds=1,
+            straggler_rate=0.6, straggler_min_work=0.4,
+            scan_rounds=True, pipeline=True,
+            checkpoint_every=1, ckpt_every_spans=1, keep_checkpoints=2,
+            seed=0, **knobs).validate()
+        model = FedModel(None, loss_fn, cfg,
+                         params={"w": jnp.zeros(Dc, jnp.float32)})
+        opt = FedOptimizer(model)
+        opt.param_groups[0]["lr"] = LR
+        sch = LambdaLR(opt, lr_lambda=lambda s: 1.0)
+        smp = FedSampler(np.full(NCc, Bc), Wc, Bc, seed=7)
+        sched = RoundScheduler(cfg, model.num_clients, model.throughput)
+        smp.scheduler = sched
+        model.attach_scheduler(sched)
+        model.attach_data_sampler(smp)
+        jpath = os.path.join(workdir, "journal.jsonl")
+        tele = TelemetrySession(journal=RunJournal(
+            jpath, run_id="bench", async_writer=True))
+        model.attach_telemetry(tele)
+        hook = make_span_checkpoint(
+            os.path.join(workdir, "ck"), model, cfg, sch)
+        done = [0]
+
+        def stream():
+            while done[0] < ROUNDS_C:
+                sched.begin_epoch(done[0])
+                for ids, idx, mask in smp.epoch():
+                    ids_arr = np.asarray(ids)
+                    yield (done[0], ids_arr,
+                           (x[ids_arr[:, None], idx],
+                            y[ids_arr[:, None], idx]), mask, LR)
+                    done[0] += 1
+                    if done[0] >= ROUNDS_C:
+                        return
+
+        with alarm_guard(STAGE_TIMEOUT,
+                         f"adaptive={adaptive} rounds"):
+            t0 = time.perf_counter()
+            ok = run_scanned_rounds(model, stream(),
+                                    model.control_bank or 1,
+                                    lambda *a: True, checkpoint=hook,
+                                    pipeline=True)
+            assert ok
+            wall = time.perf_counter() - t0
+        model.close_persistence()
+        tele.close(ok=True)
+        recs, problems = validate_journal(jpath)
+        assert not problems, problems
+        secs = np.asarray([r["seconds"] for r in recs
+                           if r.get("event") == "round"],
+                          np.float64)[WARMUP:]
+        weights = np.asarray(model.server.ps_weights)
+        assert np.all(np.isfinite(weights)), \
+            "bench workload diverged — lower LR"
+        ctls = summarize(recs).get("controllers", {})
+        return {
+            "p50_round_s": round(float(np.percentile(secs, 50)), 6),
+            "p95_round_s": round(float(np.percentile(secs, 95)), 6),
+            "rounds": int(len(secs) + WARMUP),
+            "wall_s": round(wall, 3),
+            "adjustments": {n: v["adjustments"]
+                            for n, v in sorted(ctls.items())},
+        }
+
+    with tempfile.TemporaryDirectory() as td_s, \
+            tempfile.TemporaryDirectory() as td_a:
+        static = run_arm(False, td_s)
+        adaptive = run_arm(True, td_a)
+
+    want = {"speed_match", "span_cadence", "staleness_decay"}
+    inert = sorted(want - {n for n, c in adaptive["adjustments"].items()
+                           if c >= 1})
+    assert not inert, f"controller(s) never adjusted: {inert}"
+    vs_static = (adaptive["p95_round_s"] / static["p95_round_s"]
+                 if static["p95_round_s"] > 0 else None)
+    out = {
+        "metric": "self_tuning_round_cadence",
+        "value": adaptive["p95_round_s"],
+        "unit": "s/round (p95 per-round seconds, journal round events)",
+        "vs_baseline": None,
+        "vs_static": None if vs_static is None else round(vs_static, 4),
+        "platform": platform,
+        "geometry": {"D": Dc, "num_workers": Wc, "local_batch": Bc,
+                     "num_clients": NCc, "rounds": ROUNDS_C,
+                     "straggler_rate": 0.6, "span_palette": "1,2,4",
+                     "ckpt_every_spans": 1, "mode": "uncompressed"},
+        "static": static,
+        "adaptive": adaptive,
+    }
+    journal_digest(out, "bench_digest")
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def trace_main() -> int:
     """ISSUE 13 graftscope arm: the pipelined cadence workload of
     pipeline_main rerun with --trace armed, so the bench digest gains
@@ -1312,6 +1476,12 @@ if __name__ == "__main__":
         # ISSUE 10 pipeline cadence sweep: in-process (CPU-friendly);
         # sync vs pipelined round cadence from journal round events
         raise SystemExit(worker_entry(pipeline_main))
+    if (os.environ.get("BENCH_CONTROL") == "1"
+            or "--control" in sys.argv):
+        # ISSUE 20 self-tuning control sweep: in-process
+        # (CPU-friendly); static vs adaptive per-round cadence under
+        # a heavy straggler load, all three controllers live
+        raise SystemExit(worker_entry(control_main))
     if (os.environ.get("BENCH_TRACE") == "1"
             or "--trace" in sys.argv):
         # ISSUE 13 graftscope arm: stage-resolved cadence (per-stage

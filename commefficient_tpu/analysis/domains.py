@@ -284,3 +284,47 @@ def precision_seam_pairs() -> set:
     """The registered (src dtype name, dst dtype name) pairs — what
     graftnum NU002 matches traced convert_element_type eqns against."""
     return {(s["src"], s["dst"]) for s in PRECISION_SEAMS.values()}
+
+
+# ---------------------------------------------------------------------------
+# controller wire-field registry (ISSUE 20; enforced by graftlint GL014)
+#
+# The control/ subsystem's replay contract rides each controller's
+# adjusted value on a named RoundPlan wire field ("controls" payload
+# key, see parallel/plantransport.serialize_plan): the journaled plan
+# stream is the authoritative adjustment log a takeover replays, so a
+# wire-field collision means two controllers silently overwrite each
+# other's decisions on the wire — invisible at runtime, catastrophic
+# on a resume. This registry is the ONE place wire fields are claimed,
+# mirroring the DOMAINS discipline: controller name -> wire field,
+# uniqueness asserted at import time and re-proven pure-AST by
+# graftlint GL014 (which also flags any `WIRE_FIELD = "..."` class
+# attribute in the tree whose literal is not registered here). Names
+# and fields are FROZEN once shipped — a renamed field orphans every
+# historical journal's plan stream.
+CONTROL_FIELDS = {
+    "screen_adapt": "screen_mult",      # control/screen (ISSUE 17)
+    "speed_match": "speed_ratio",       # control/speed
+    "span_cadence": "scan_span",        # control/span
+    "staleness_decay": "staleness_decay",  # control/staleness
+}
+
+_fields = list(CONTROL_FIELDS.values())
+assert len(set(_fields)) == len(_fields), (
+    "controller wire-field collision in analysis/domains."
+    "CONTROL_FIELDS: two controllers sharing a plan wire field "
+    "silently overwrite each other's journaled adjustments")
+
+
+def control_field(name: str) -> str:
+    """The registered plan wire field for controller `name`; KeyError
+    (with the known names listed) on a typo rather than a silent new
+    wire field."""
+    try:
+        return CONTROL_FIELDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown controller {name!r}; registered: "
+            f"{sorted(CONTROL_FIELDS)} (add new controllers to "
+            "analysis/domains.CONTROL_FIELDS)"
+        ) from None

@@ -963,6 +963,80 @@ def check_gl013(module: ModuleInfo) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GL014 — controller plan wire fields outside the central registry
+
+# The control/ subsystem (ISSUE 20) rides every controller's adjusted
+# value on a named RoundPlan wire field; the journaled plan stream is
+# the authoritative adjustment log a coordinator takeover replays.
+# Those fields live in analysis/domains.CONTROL_FIELDS — the one place
+# uniqueness is asserted — because two controllers sharing a field
+# silently overwrite each other's wire decisions (invisible at
+# runtime, catastrophic on a resume). This rule holds the line
+# syntactically, mirroring GL009: (a) a `WIRE_FIELD = "..."` class
+# attribute anywhere in the tree whose string literal is not a
+# registered CONTROL_FIELDS value is a controller that bypassed the
+# registry; (b) a duplicate value inside the registry dict itself is a
+# collision, re-proven pure-AST on the literal dict.
+
+from commefficient_tpu.analysis.domains import CONTROL_FIELDS  # noqa: E402
+
+_GL014_ATTR = "WIRE_FIELD"
+_GL014_REGISTRY_SUFFIX = "analysis/domains.py"
+
+
+def check_gl014(module: ModuleInfo) -> Iterator[Violation]:
+    # (a) unregistered WIRE_FIELD class attributes, tree-wide: the
+    # attribute name is the control/ base-class contract, so any
+    # assignment to it claims a wire field
+    registered = set(CONTROL_FIELDS.values())
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == _GL014_ATTR
+                        for t in node.targets)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        field = node.value.value
+        if field and field not in registered:
+            yield Violation(
+                module.path, node.lineno, node.col_offset, "GL014",
+                f"controller wire field {field!r} is not registered "
+                "in analysis/domains.CONTROL_FIELDS: the registry is "
+                "where wire-field uniqueness is asserted — an "
+                "unregistered field can silently collide with an "
+                "existing controller's journaled plan stream")
+    # (b) collisions inside the registry itself (pure AST — the
+    # import-time assert re-proven syntactically on the literal dict)
+    if not module.path.replace(os.sep, "/").endswith(
+            _GL014_REGISTRY_SUFFIX):
+        return
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "CONTROL_FIELDS"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        seen: Dict[str, str] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                continue
+            name = (k.value if isinstance(k, ast.Constant) else
+                    module.segment(k))
+            if v.value in seen:
+                yield Violation(
+                    module.path, v.lineno, v.col_offset, "GL014",
+                    f"controller wire-field collision: {name!r} "
+                    f"reuses field {v.value!r} already registered to "
+                    f"{seen[v.value]!r} — two controllers on one wire "
+                    "field overwrite each other's plan-carried "
+                    "adjustments")
+            else:
+                seen[v.value] = name
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "GL001": check_gl001,
@@ -978,6 +1052,7 @@ ALL_RULES = {
     "GL011": check_gl011,
     "GL012": check_gl012,
     "GL013": check_gl013,
+    "GL014": check_gl014,
 }
 
 RULE_DOCS = {
@@ -1012,4 +1087,7 @@ RULE_DOCS = {
     "GL013": "float ==/!= on traced values (non-zero literal or "
              "computed comparand) — one ulp of reassociation drift "
              "flips it; exact-zero sparsity tests stay legal",
+    "GL014": "controller plan wire field outside the analysis/domains "
+             "CONTROL_FIELDS registry (unregistered WIRE_FIELD class "
+             "attribute, or a registry collision)",
 }

@@ -287,6 +287,44 @@ class Config:
     screen_adapt_step: float = 0.5
     screen_mult_min: float = 1.5
     screen_mult_max: float = 64.0
+    # plan-riding controller bank (control/, ISSUE 20): three
+    # self-tuning loops on the ISSUE-17 pattern — every adjustment is
+    # bounded, f32-rounded, rides the journaled RoundPlan (`controls`
+    # wire dict), and is installed (never recomputed) by followers and
+    # replayed rounds. All off by default: make_bank returns None and
+    # the loop is bit-identical to a pre-controller build.
+    #
+    # cohort speed-matching (control/speed.py): clients whose
+    # examples/sec EMA falls below speed_ratio x cohort-median get a
+    # work fraction < 1 min-composed onto plan.work, which the async
+    # admission buffer defers into an --async_admit_rounds slot; the
+    # ratio is nudged so the deferred fraction tracks
+    # speed_match_target, clamped to [speed_ratio_min,
+    # speed_ratio_max] (max < 1 — "slow" must mean strictly slower
+    # than the median).
+    speed_match: bool = False
+    speed_match_target: float = 0.25
+    speed_match_step: float = 0.25
+    speed_ratio: float = 0.5
+    speed_ratio_min: float = 0.25
+    speed_ratio_max: float = 0.9
+    # adaptive span cadence (control/span.py): comma-separated span
+    # lengths ("1,2,4") the scanned staging loop may flush at; each
+    # entry's program traces ONCE at warmup (the palette is the whole
+    # shape vocabulary — steady state stays zero-recompile) and the
+    # per-entry seconds-per-round EMA picks the steady-state length.
+    # Must include 1 (the stream tail decomposes greedily over the
+    # palette). Empty = static --scan_span, the default.
+    scan_span_palette: str = ""
+    # adaptive staleness decay (control/staleness.py): the
+    # estimate_residual metric drives async_staleness_decay between
+    # [staleness_decay_min, staleness_decay_max] — residual above
+    # staleness_target discounts late admissions harder.
+    adapt_staleness: bool = False
+    staleness_target: float = 0.3
+    staleness_step: float = 0.25
+    staleness_decay_min: float = 0.2
+    staleness_decay_max: float = 0.95
     # finite-frontier auto-rollback (the drivers' numeric_trip
     # handler): after a non-finite update/error-l2 trips telemetry and
     # the run rolls back to the newest finite checkpoint, screening is
@@ -643,6 +681,29 @@ class Config:
         return (self.target_screened_rate >= 0.0
                 and self.update_screen == "norm")
 
+    @property
+    def span_palette(self) -> tuple:
+        """Parsed --scan_span_palette: ascending unique span lengths,
+        () when the adaptive span-cadence controller is off. Ascending
+        order is the warmup trace order AND the argmin tie-break
+        (np.argmin takes the first minimum → the shortest span wins a
+        cadence tie), so the trajectory is deterministic in the flag
+        string."""
+        s = self.scan_span_palette.strip()
+        if not s:
+            return ()
+        return tuple(sorted({int(tok) for tok in s.split(",")
+                             if tok.strip()}))
+
+    @property
+    def control_loop(self) -> bool:
+        """True when any bank-managed controller is enabled (the
+        drivers then build plans every round so adjustments can ride
+        them — control.make_bank returns non-None exactly when this
+        does)."""
+        return bool(self.speed_match or self.span_palette
+                    or self.adapt_staleness)
+
     def resolved_num_clients(self, dataset_num_clients: Optional[int] = None) -> int:
         if self.num_clients is not None:
             return self.num_clients
@@ -927,6 +988,79 @@ class Config:
                 "the slot/weight stream is digest-cross-checked) — "
                 "attach --plan_transport collective "
                 "(parallel/plantransport.py)")
+        if self.speed_match:
+            if self.async_admit_rounds <= 0:
+                raise ValueError(
+                    "--speed_match defers measured-slow clients into "
+                    "async admission slots — it needs "
+                    "--async_admit_rounds > 0 to have somewhere to "
+                    "put them")
+            if not 0.0 < self.speed_match_target < 1.0:
+                raise ValueError(
+                    f"speed_match_target={self.speed_match_target} "
+                    "must be in (0, 1) (the deferred cohort fraction "
+                    "the ratio is steered toward)")
+            if self.speed_match_step <= 0:
+                raise ValueError(
+                    "speed_match_step must be > 0 (the multiplicative "
+                    "adjustment per observed round)")
+            if not (0.0 < self.speed_ratio_min
+                    <= self.speed_ratio_max < 1.0):
+                raise ValueError(
+                    f"need 0 < speed_ratio_min={self.speed_ratio_min} "
+                    f"<= speed_ratio_max={self.speed_ratio_max} < 1: "
+                    "a ratio >= 1 would flag at-median clients as "
+                    "slow and could defer half the cohort every round")
+        if self.scan_span_palette.strip():
+            pal = self.span_palette
+            if any(p <= 0 for p in pal):
+                raise ValueError(
+                    f"scan_span_palette={self.scan_span_palette!r}: "
+                    "span lengths must be positive")
+            if 1 not in pal:
+                raise ValueError(
+                    f"scan_span_palette={self.scan_span_palette!r} "
+                    "must include 1: the stream tail decomposes "
+                    "greedily over the palette, and only a 1-span can "
+                    "finish an arbitrary leftover without tracing a "
+                    "new program shape")
+            if not self.scan_rounds:
+                raise ValueError(
+                    "--scan_span_palette sizes the scanned staging "
+                    "loop — enable --scan_rounds")
+            if self.scan_span > 0:
+                raise ValueError(
+                    "--scan_span and --scan_span_palette are mutually "
+                    "exclusive: the palette controller owns the span "
+                    "length (static spans = --scan_span alone)")
+        if self.adapt_staleness:
+            if self.async_admit_rounds <= 0:
+                raise ValueError(
+                    "--adapt_staleness tunes the async admission "
+                    "staleness discount — it needs "
+                    "--async_admit_rounds > 0 for the discount to "
+                    "apply to anything")
+            if self.staleness_step <= 0:
+                raise ValueError(
+                    "staleness_step must be > 0 (the multiplicative "
+                    "adjustment per observed round)")
+            if not (0.0 < self.staleness_decay_min
+                    <= self.staleness_decay_max <= 1.0):
+                raise ValueError(
+                    f"need 0 < staleness_decay_min="
+                    f"{self.staleness_decay_min} <= staleness_decay_max="
+                    f"{self.staleness_decay_max} <= 1 (1.0 = "
+                    "undiscounted late admission)")
+            if (self.pipeline and self.scan_rounds
+                    and self.scan_span <= 0
+                    and not self.scan_span_palette.strip()):
+                raise ValueError(
+                    "--adapt_staleness stamps a fixed-lag decay (the "
+                    "lag bounds how far staging can run ahead of "
+                    "commits), so pipelined --scan_rounds needs a "
+                    "bounded span: set --scan_span or "
+                    "--scan_span_palette (epoch-sized spans have no "
+                    "static bound)")
         if self.writer_drain_timeout_s < 0:
             raise ValueError(
                 "writer_drain_timeout_s must be >= 0 (0 = wait "
@@ -1194,6 +1328,58 @@ def _build_parser(default_lr: Optional[float] = None) -> argparse.ArgumentParser
     p.add_argument("--screen_mult_max", type=float, default=64.0,
                    help="adaptive screening threshold ceiling "
                         "(Config.screen_mult_max)")
+    p.add_argument("--speed_match", action="store_true",
+                   help="cohort speed-matching controller "
+                        "(control/speed.py): defer clients measured "
+                        "slower than speed_ratio x cohort-median rate "
+                        "into --async_admit_rounds slots, the ratio "
+                        "self-tuning toward --speed_match_target "
+                        "(requires --async_admit_rounds > 0)")
+    p.add_argument("--speed_match_target", type=float, default=0.25,
+                   help="deferred cohort fraction the speed-matching "
+                        "ratio is steered toward "
+                        "(Config.speed_match_target)")
+    p.add_argument("--speed_match_step", type=float, default=0.25,
+                   help="speed-matching multiplicative step per "
+                        "observed round (Config.speed_match_step)")
+    p.add_argument("--speed_ratio", type=float, default=0.5,
+                   help="starting slow-client threshold as a fraction "
+                        "of the cohort median rate "
+                        "(Config.speed_ratio)")
+    p.add_argument("--speed_ratio_min", type=float, default=0.25,
+                   help="speed-matching ratio floor "
+                        "(Config.speed_ratio_min)")
+    p.add_argument("--speed_ratio_max", type=float, default=0.9,
+                   help="speed-matching ratio ceiling; must stay < 1 "
+                        "(Config.speed_ratio_max)")
+    p.add_argument("--scan_span_palette", type=str, default="",
+                   help="adaptive span cadence (control/span.py): "
+                        "comma-separated span lengths the scanned "
+                        "staging loop may flush at, e.g. 1,2,4 — each "
+                        "traces once at warmup, the seconds-per-round "
+                        "EMA picks the steady-state length; must "
+                        "include 1; empty = static --scan_span "
+                        "(Config.scan_span_palette)")
+    p.add_argument("--adapt_staleness", action="store_true",
+                   help="adaptive staleness decay "
+                        "(control/staleness.py): drive "
+                        "async_staleness_decay from the "
+                        "estimate_residual metric between the "
+                        "configured bounds (requires "
+                        "--async_admit_rounds > 0)")
+    p.add_argument("--staleness_target", type=float, default=0.3,
+                   help="estimate_residual level above which late "
+                        "admissions are discounted harder "
+                        "(Config.staleness_target)")
+    p.add_argument("--staleness_step", type=float, default=0.25,
+                   help="staleness-decay multiplicative step per "
+                        "observed round (Config.staleness_step)")
+    p.add_argument("--staleness_decay_min", type=float, default=0.2,
+                   help="adaptive staleness decay floor "
+                        "(Config.staleness_decay_min)")
+    p.add_argument("--staleness_decay_max", type=float, default=0.95,
+                   help="adaptive staleness decay ceiling "
+                        "(Config.staleness_decay_max)")
     p.add_argument("--rollback_screen_rounds", type=int, default=8,
                    help="after a numeric_trip rollback, force update "
                         "screening on for this many rounds so the "

@@ -1,0 +1,55 @@
+"""commefficient_tpu.control — plan-riding feedback controllers
+(ISSUE 20).
+
+Closes the telemetry → scheduler → pipeline loop: PR 13 built the
+complete measurement substrate and PR 17 proved the one safe pattern
+for acting on it (controller state under the scheduler checkpoint,
+the adjusted value a journaled RoundPlan wire field, replayed — never
+recomputed — on restart or takeover). This package promotes that
+pattern into a subsystem:
+
+  base.py       Controller contract + ControllerBank composition
+  screen.py     AdaptiveScreenController (PR 17, migrated verbatim)
+  speed.py      cohort speed-matching → async admission deferral
+  span.py       adaptive span cadence over a traced palette
+  staleness.py  estimate-residual-driven staleness decay
+
+Wire fields are registered in analysis/domains.CONTROL_FIELDS
+(import-time uniqueness assert + graftlint GL014 AST re-proof);
+`make_bank` is the single config → bank factory both drivers reach
+through FedModel — it returns None when no controller flag is set, so
+default runs construct nothing and stay bit-identical to pre-PR.
+"""
+from __future__ import annotations
+
+from commefficient_tpu.control.base import (
+    Adjustment, Controller, ControllerBank,
+)
+from commefficient_tpu.control.screen import AdaptiveScreenController
+from commefficient_tpu.control.span import SpanCadenceController
+from commefficient_tpu.control.speed import SpeedMatchController
+from commefficient_tpu.control.staleness import StalenessDecayController
+
+__all__ = [
+    "Adjustment", "AdaptiveScreenController", "Controller",
+    "ControllerBank", "SpanCadenceController", "SpeedMatchController",
+    "StalenessDecayController", "make_bank",
+]
+
+
+def make_bank(cfg):
+    """Build the run's ControllerBank from config flags, or None when
+    no bank-managed controller is enabled (the default — the loop then
+    constructs nothing and runs bit-identical to a pre-controller
+    build). The screen controller is NOT bank-managed: it predates the
+    bank and keeps its dedicated RoundScheduler.screen_ctl wiring."""
+    controllers = []
+    if cfg.speed_match:
+        controllers.append(SpeedMatchController(cfg))
+    if cfg.span_palette:
+        controllers.append(SpanCadenceController(cfg))
+    if cfg.adapt_staleness:
+        controllers.append(StalenessDecayController(cfg))
+    if not controllers:
+        return None
+    return ControllerBank(controllers)

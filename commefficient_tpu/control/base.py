@@ -1,0 +1,299 @@
+"""Controller base contract + ControllerBank (ISSUE 20).
+
+PR 17's AdaptiveScreenController proved the ONE safe shape for a
+feedback controller inside a replay-exact engine, and this module
+promotes that shape from a one-off into the subsystem contract:
+
+  * OBSERVE telemetry-derived signals on the host — at plan-stamp
+    (draw) time for wall-clock signals like throughput EMAs and span
+    cadence, at round-commit time for device-deterministic signals
+    like the estimate-residual metric;
+  * emit a BOUNDED adjustment (multiplicative step, clamped to
+    configured [lo, hi], f32-rounded so the journaled plan, the
+    install digest, and any traced operand all carry the identical
+    value);
+  * RIDE the adjusted value on a registered RoundPlan wire field
+    (analysis/domains.CONTROL_FIELDS — uniqueness asserted at import
+    time and re-proven pure-AST by graftlint GL014), journaled in the
+    write-ahead `schedule` event and digest-covered like every other
+    plan field;
+  * REPLAY, never recompute: a crash-resume or coordinator takeover
+    installs the journaled plan bytes verbatim, and `install()` adopts
+    the plan-carried value as the live state — so the adjustment
+    trajectory is a pure function of the durable plan stream, not of
+    any process's local clock;
+  * serialize state under the scheduler checkpoint (sched_* keys,
+    `ctl_<name>_<key>` namespace) so a resumed run continues the
+    trajectory from the boundary.
+
+Adjustments NEVER touch the traced programs: every controller output
+is a host-side value riding operands the round programs already carry
+(work fractions, the async-admit decay, the span length the staging
+loop flushes at) — the standing three-programs / zero-new-programs
+contract for defaults holds, and `make_bank` returns None when no
+controller flag is set, keeping the default loop bit-identical.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from commefficient_tpu.analysis.domains import CONTROL_FIELDS
+
+__all__ = ["Adjustment", "Controller", "ControllerBank"]
+
+
+class Adjustment(NamedTuple):
+    """One journaled controller move: the payload of a `control`
+    journal event (telemetry/journal.py validates the schema)."""
+    controller: str   # Controller.NAME
+    round_idx: int    # the round the adjustment was decided at
+    signal: float     # the observed signal that drove the move
+    old: float        # value before (f32-rounded)
+    new: float        # value after (f32-rounded)
+    clamped: bool     # True when the raw step hit a configured bound
+
+
+class Controller:
+    """Base class: one bounded, plan-riding, replay-exact knob.
+
+    Subclasses set NAME (journal identity) and WIRE_FIELD (the
+    RoundPlan `controls` key — MUST be registered to NAME in
+    analysis/domains.CONTROL_FIELDS; the ControllerBank asserts it and
+    graftlint GL014 re-proves it pure-AST), list their persisted
+    attributes in STATE_KEYS, and override the hooks they need:
+
+      * stamp(round_idx, ids, ex, tracker) — draw-time: observe
+        wall-clock scheduling signals, adjust, and return the value to
+        ride the plan (plus an optional per-slot work composition and
+        the Adjustment, if any). Runs ONLY on a fresh coordinator
+        round — followers and replays install instead.
+      * observe_commit(round_idx, signals) — commit-time: adjust from
+        device-deterministic signals (metric values). Runs on every
+        committed round, replayed rounds included — deterministic
+        signals reproduce the identical trajectory.
+      * install(value) — adopt a plan-carried value as live state (a
+        broadcast or journaled plan always wins over local state).
+    """
+
+    NAME = ""
+    WIRE_FIELD = ""
+    STATE_KEYS: Tuple[str, ...] = ()
+    # True for the controller that owns the staging loop's span size
+    # (the bank routes the drivers' span_cap queries to it)
+    provides_span_cap = False
+    # True when the controller's state advances at round-COMMIT time
+    # (collect order) rather than draw time: a pipelined span
+    # checkpoint must then save the live-at-save state — the
+    # dispatch-time snapshot predates the previous span's collect —
+    # exactly the accountant's save discipline (scanloop.
+    # make_span_checkpoint merges ControllerBank.commit_state_dict)
+    COMMIT_STATE = False
+
+    # ---------------- value plumbing ----------------------------------
+    @staticmethod
+    def _f32(x) -> float:
+        """f32-round a host float so the journaled plan, the digest,
+        and any traced operand agree bit-for-bit."""
+        return float(np.float32(x))
+
+    def plan_value(self):
+        """The value the NEXT stamped plan rides (f32-rounded floats;
+        ints pass through exact)."""
+        raise NotImplementedError
+
+    def install(self, value) -> None:
+        """Adopt a plan-carried value (broadcast / journaled replay):
+        the durable plan stream is the authoritative trajectory, so
+        the live state follows it — never the other way around."""
+        raise NotImplementedError
+
+    # ---------------- observation hooks -------------------------------
+    def stamp(self, round_idx: int, ids: np.ndarray, ex: np.ndarray,
+              tracker) -> Tuple[object, Optional[np.ndarray],
+                                Optional[Adjustment]]:
+        """Draw-time hook (fresh coordinator rounds only). Returns
+        (wire value, optional [W] work-fraction composition riding
+        plan.work, optional Adjustment). Default: stamp the current
+        value, no work, no move."""
+        del round_idx, ids, ex, tracker
+        return self.plan_value(), None, None
+
+    def observe_commit(self, round_idx: int,
+                       signals: dict) -> Optional[Adjustment]:
+        """Commit-time hook, fed EVERY committed round (replays
+        included): signals must be device-deterministic so a replayed
+        round re-observes identically. Default: no-op."""
+        del round_idx, signals
+        return None
+
+    def feed_span(self, round_idx: int, n_rounds: int,
+                  seconds: float) -> Optional[Adjustment]:
+        """Span-collect hook (wall-clock span timing). Default:
+        no-op."""
+        del round_idx, n_rounds, seconds
+        return None
+
+    # ---------------- checkpoint round-trip ---------------------------
+    def _state_key(self, key: str) -> str:
+        return f"ctl_{self.NAME}_{key}"
+
+    def state_dict(self) -> dict:
+        out = {}
+        for key in self.STATE_KEYS:
+            out[self._state_key(key)] = np.asarray(getattr(self, key))
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        # legacy checkpoints (pre-controller) carry no ctl_* keys:
+        # keep the config-derived start point
+        for key in self.STATE_KEYS:
+            full = self._state_key(key)
+            if full not in state:
+                continue
+            cur = getattr(self, key)
+            v = np.asarray(state[full])
+            if isinstance(cur, bool) or isinstance(cur, np.ndarray):
+                setattr(self, key, v)
+            elif isinstance(cur, int):
+                setattr(self, key, int(v))
+            elif isinstance(cur, float):
+                setattr(self, key, float(v))
+            else:
+                setattr(self, key, v)
+
+
+class ControllerBank:
+    """Ordered composition of controllers for one run.
+
+    One instance per run, created by FedModel (control.make_bank) and
+    shared with the RoundScheduler (attach_scheduler) — the scheduler
+    stamps every fresh coordinator plan through it, the model installs
+    plan-carried values and feeds commit/span observations, and its
+    merged state rides the scheduler's sched_* checkpoint keys.
+    Adjustments queue here until the model drains them into `control`
+    journal events (take_events), so the bank itself stays
+    journal-agnostic.
+    """
+
+    def __init__(self, controllers):
+        self.controllers: List[Controller] = list(controllers)
+        self._by_field: Dict[str, Controller] = {}
+        self._span_ctl: Optional[Controller] = None
+        for c in self.controllers:
+            if CONTROL_FIELDS.get(c.NAME) != c.WIRE_FIELD:
+                raise ValueError(
+                    f"controller {c.NAME!r} rides wire field "
+                    f"{c.WIRE_FIELD!r}, but analysis/domains."
+                    f"CONTROL_FIELDS registers "
+                    f"{CONTROL_FIELDS.get(c.NAME)!r} — register the "
+                    "field before shipping the controller")
+            if c.WIRE_FIELD in self._by_field:
+                raise ValueError(
+                    f"two controllers share wire field "
+                    f"{c.WIRE_FIELD!r}: {self._by_field[c.WIRE_FIELD].NAME!r} "
+                    f"and {c.NAME!r}")
+            self._by_field[c.WIRE_FIELD] = c
+            if c.provides_span_cap:
+                self._span_ctl = c
+        self._events: List[Adjustment] = []
+
+    def __len__(self) -> int:
+        return len(self.controllers)
+
+    @property
+    def names(self) -> list:
+        return [c.NAME for c in self.controllers]
+
+    # ---------------- scheduler side ----------------------------------
+    def stamp_plan(self, plan, ids: np.ndarray, ex: np.ndarray,
+                   tracker):
+        """Fresh-coordinator stamp: run every controller's draw-time
+        hook, min-compose any work fractions onto the plan (the same
+        host-side merge deadline truncation rides), and seal the wire
+        values into plan.controls. Queued adjustments journal at the
+        model's next drain."""
+        controls = {}
+        work = plan.work
+        for c in self.controllers:
+            value, cwork, adj = c.stamp(int(plan.round_idx), ids, ex,
+                                        tracker)
+            controls[c.WIRE_FIELD] = value
+            if cwork is not None:
+                cwork = np.asarray(cwork, np.float32)
+                work = (cwork if work is None
+                        else np.minimum(np.asarray(work, np.float32),
+                                        cwork))
+            if adj is not None:
+                self._events.append(adj)
+        return plan._replace(work=work, controls=controls)
+
+    # ---------------- model side --------------------------------------
+    def install(self, controls: dict) -> None:
+        """Adopt a plan's carried values (broadcast / replay / the
+        coordinator's own round-tripped stamp — idempotent there)."""
+        for field, value in controls.items():
+            c = self._by_field.get(field)
+            if c is not None:
+                c.install(value)
+
+    def observe_commit(self, round_idx: int, signals: dict) -> None:
+        for c in self.controllers:
+            adj = c.observe_commit(int(round_idx), signals)
+            if adj is not None:
+                self._events.append(adj)
+
+    def feed_span(self, round_idx: int, n_rounds: int,
+                  seconds: float) -> None:
+        for c in self.controllers:
+            adj = c.feed_span(int(round_idx), int(n_rounds),
+                              float(seconds))
+            if adj is not None:
+                self._events.append(adj)
+
+    def take_events(self) -> List[Adjustment]:
+        """Drain queued adjustments (the model journals each as a
+        `control` event)."""
+        events, self._events = self._events, []
+        return events
+
+    # ---------------- staging-loop span size --------------------------
+    def span_cap(self, default: int) -> int:
+        """The span size the staging loop should flush at next (the
+        span-cadence controller's live pick, or `default`)."""
+        if self._span_ctl is None:
+            return int(default)
+        return int(self._span_ctl.span_cap())
+
+    def tail_cap(self, leftover: int) -> int:
+        """Largest already-traced span size <= leftover, for the
+        stream-tail decomposition (palette includes 1, so this always
+        exists); identity without a span controller."""
+        if self._span_ctl is None:
+            return int(leftover)
+        return int(self._span_ctl.tail_cap(int(leftover)))
+
+    # ---------------- checkpoint round-trip ---------------------------
+    def state_dict(self) -> dict:
+        out = {}
+        for c in self.controllers:
+            out.update(c.state_dict())
+        return out
+
+    def commit_state_dict(self) -> dict:
+        """State of the COMMIT_STATE controllers only — the keys a
+        pipelined span checkpoint overlays live at save time (the
+        boundary snapshot predates the previous span's collect, but
+        commit-time state advances in span order, so the live read at
+        save time is the span-consistent one — the accountant's
+        discipline)."""
+        out = {}
+        for c in self.controllers:
+            if c.COMMIT_STATE:
+                out.update(c.state_dict())
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        for c in self.controllers:
+            c.load_state_dict(state)

@@ -1,0 +1,121 @@
+"""Adaptive norm-screen controller (ISSUE 17 → migrated, ISSUE 20).
+
+This is PR 17's `AdaptiveScreenController`, moved from
+scheduler/__init__.py onto the `Controller` base unchanged in
+behavior: same config knobs, same f32 step/clamp arithmetic, same
+legacy (unprefixed) checkpoint keys, same `observe(round_idx,
+n_screened, n_cohort)` call the model's screening commit path already
+makes — tests/test_control.py proves the `screen_mult` trajectory and
+`screen_adapt` journal stream are bit-identical to the pre-migration
+build. It keeps riding `RoundScheduler.screen_ctl` (its wiring
+predates the ControllerBank and its wire field `screen_mult` is a
+top-level RoundPlan field, not a `controls` entry), but its NAME /
+WIRE_FIELD registration now flows through the same CONTROL_FIELDS
+registry and GL014 lint as the bank-managed controllers.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from commefficient_tpu.control.base import Controller
+
+__all__ = ["AdaptiveScreenController"]
+
+
+class AdaptiveScreenController(Controller):
+    """Closed-loop tuner for the norm-screen threshold (ISSUE 17).
+
+    PR 16's update screening rejects client updates whose l2 norm
+    exceeds ``screen_norm_mult`` times the cohort median — a STATIC
+    multiplier, so an operator has to guess how aggressive the screen
+    should be before seeing the run. This controller closes the loop:
+    it watches the journaled per-round screened rate and nudges the
+    multiplier multiplicatively toward ``--target_screened_rate``
+    (observed rate above target → loosen, below → tighten), clamped to
+    [screen_mult_min, screen_mult_max].
+
+    Determinism contract: every adjustment is pure f32 arithmetic on
+    journal-materialized integer counts — no wall clock, no RNG — and
+    the multiplier each round dispatches with RIDES THE ROUNDPLAN
+    (``RoundPlan.screen_mult``), coordinator-broadcast under
+    ``--plan_transport`` and replayed (not recomputed) from the
+    write-ahead journal on a restart or takeover. The traced program
+    never changes: the screen operand PR 16 already threads into the
+    jitted round carries the live multiplier as its VALUE, and its
+    plan-digest coverage (install_digest's screen_on field) extends to
+    the multiplier for free. ``screen_mult_min`` must stay > 1 so the
+    adapted value can never collide with the screen-off sentinel 0.
+
+    One instance per run, created by FedModel and shared with the
+    RoundScheduler (attach_scheduler): the model consults it for
+    transport-free dispatch, the scheduler stamps it into broadcast
+    plans. Its state rides the scheduler's sched_* checkpoint keys so
+    a resumed run continues the trajectory bit-exactly.
+    """
+
+    NAME = "screen_adapt"
+    WIRE_FIELD = "screen_mult"
+    # legacy key names (pre-ControllerBank): checkpoints written by
+    # PR 17..19 builds must keep restoring, so the base class's
+    # ctl_<name>_<key> namespace does NOT apply here
+    STATE_KEYS = ("screen_mult", "screen_rounds_observed")
+
+    def __init__(self, cfg):
+        self.target = float(cfg.target_screened_rate)
+        self.step = float(cfg.screen_adapt_step)
+        self.lo = float(cfg.screen_mult_min)
+        self.hi = float(cfg.screen_mult_max)
+        self.mult = float(np.float32(
+            min(max(float(cfg.screen_norm_mult), self.lo), self.hi)))
+        self.rounds_observed = 0
+
+    def plan_mult(self) -> float:
+        """The multiplier the NEXT round dispatches with — f32-rounded
+        so the journaled plan, the install digest, and the traced
+        screen operand all carry the identical value."""
+        return float(np.float32(self.mult))
+
+    # Controller-contract aliases
+    def plan_value(self) -> float:
+        return self.plan_mult()
+
+    def install(self, value) -> None:
+        self.mult = float(value)
+
+    def observe(self, round_idx: int, n_screened: int,
+                n_cohort: int) -> Optional[tuple]:
+        """Feed one committed round's observed screened count (EVERY
+        round, zero included — the controller's trajectory is a pure
+        function of the observation stream, so skipping quiet rounds
+        would desync a resumed run). Returns (old_mult, new_mult,
+        rate) when the threshold moved, else None."""
+        del round_idx  # trajectory is stream-positional, not indexed
+        self.rounds_observed += 1
+        rate = float(n_screened) / float(max(int(n_cohort), 1))
+        old = self.plan_mult()
+        if rate > self.target:
+            new = min(old * (1.0 + self.step), self.hi)
+        elif rate < self.target:
+            new = max(old / (1.0 + self.step), self.lo)
+        else:
+            new = old
+        new = float(np.float32(new))
+        self.mult = new
+        if new != old:
+            return (old, new, rate)
+        return None
+
+    def state_dict(self) -> dict:
+        return {"screen_mult": np.float64(self.mult),
+                "screen_rounds_observed": np.int64(
+                    self.rounds_observed)}
+
+    def load_state_dict(self, state: dict) -> None:
+        # legacy checkpoints (pre-17) carry no controller keys: keep
+        # the config-derived start point
+        if "screen_mult" in state:
+            self.mult = float(np.asarray(state["screen_mult"]))
+            self.rounds_observed = int(np.asarray(
+                state.get("screen_rounds_observed", 0)))

@@ -1,0 +1,117 @@
+"""Adaptive span-cadence controller (ISSUE 20).
+
+PR 10's pipelined staging loop flushes a span of staged rounds into
+one scanned device program; the span length trades per-span host
+overhead (checkpoint hooks, journal flushes, dispatch bookkeeping)
+against staging latency, and PR 13's journal measures exactly that
+trade as inter-round cadence — but the length was a static
+``--scan_span``. This controller picks the span length from a small
+static ``--scan_span_palette`` instead:
+
+  * every collected span feeds (n_rounds, wall seconds) → the
+    controller tracks a per-palette-entry EMA of SECONDS PER ROUND
+    (the journal's cadence signal, attributed to the span length that
+    produced it);
+  * warmup CYCLES through the palette once, so every palette entry's
+    scanned program is traced exactly once before steady state — the
+    palette is the complete shape vocabulary, steady state stays
+    zero-recompile, and the existing ``compile_warning`` gate
+    enforces it;
+  * after warmup the pick is the argmin-EMA entry; the stream tail
+    (fewer rounds left than the pick) decomposes greedily over the
+    palette — largest entry that fits, down to 1 (Config.validate
+    requires 1 ∈ palette) — so a tail NEVER traces a new shape.
+
+The pick rides the plan (`scan_span` wire field). Span timing is
+wall-clock, so like speed-matching the DECISION is only ever taken on
+the live fresh path, and replayed rounds install() the journaled
+pick: a resumed run reproduces the original span trajectory from the
+plan stream, while its live EMAs keep learning from fresh
+measurements for post-replay picks.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from commefficient_tpu.control.base import Adjustment, Controller
+
+__all__ = ["SpanCadenceController"]
+
+# EMA coefficient for per-entry seconds-per-round: heavy enough to
+# track load shifts, light enough to ride out one noisy span
+_CADENCE_ALPHA = 0.5
+
+
+class SpanCadenceController(Controller):
+    """Pick the staging-loop span length from a traced palette."""
+
+    NAME = "span_cadence"
+    WIRE_FIELD = "scan_span"
+    STATE_KEYS = ("choice", "spans_observed", "ema")
+    provides_span_cap = True
+
+    def __init__(self, cfg):
+        self.palette = tuple(int(p) for p in cfg.span_palette)
+        if not self.palette:
+            raise ValueError("SpanCadenceController needs a non-empty "
+                             "--scan_span_palette")
+        self.choice = int(self.palette[0])
+        self.spans_observed = 0
+        # seconds-per-round EMA per palette entry; NaN = not yet tried
+        self.ema = np.full(len(self.palette), np.nan, np.float64)
+
+    def plan_value(self) -> int:
+        return int(self.choice)
+
+    def install(self, value) -> None:
+        self.choice = int(value)
+
+    # ---------------- staging-loop queries ----------------------------
+    def span_cap(self) -> int:
+        """The span length the NEXT staged span should flush at."""
+        return int(self.choice)
+
+    def tail_cap(self, leftover: int) -> int:
+        """Largest palette entry <= leftover, for the stream-tail
+        decomposition (1 ∈ palette guarantees existence)."""
+        fits = [p for p in self.palette if p <= int(leftover)]
+        if not fits:
+            return int(min(self.palette))
+        return int(max(fits))
+
+    # ---------------- observation -------------------------------------
+    def feed_span(self, round_idx: int, n_rounds: int,
+                  seconds: float) -> Optional[Adjustment]:
+        """Feed one collected span's (length, wall seconds); returns
+        an Adjustment when the pick moves. `round_idx` is the span's
+        last round (the journal anchor)."""
+        if int(n_rounds) <= 0:
+            return None
+        per_round = float(seconds) / float(n_rounds)
+        if int(n_rounds) in self.palette:
+            i = self.palette.index(int(n_rounds))
+            if np.isnan(self.ema[i]):
+                self.ema[i] = per_round
+            else:
+                self.ema[i] = (_CADENCE_ALPHA * per_round
+                               + (1.0 - _CADENCE_ALPHA) * self.ema[i])
+        self.spans_observed += 1
+        old = int(self.choice)
+        untried = [p for i, p in enumerate(self.palette)
+                   if np.isnan(self.ema[i])]
+        if untried:
+            # warmup: trace every palette entry once before letting
+            # the EMAs pick — steady state then replays known shapes
+            new = int(untried[0])
+        else:
+            new = int(self.palette[int(np.argmin(self.ema))])
+        self.choice = new
+        if new != old:
+            # a palette pick is bounded by construction — the clamp
+            # bit is always False here
+            return Adjustment(self.NAME, int(round_idx),
+                              float(per_round), float(old), float(new),
+                              False)
+        return None

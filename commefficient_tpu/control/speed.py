@@ -1,0 +1,124 @@
+"""Cohort speed-matching controller (ISSUE 20).
+
+The ROADMAP's first named control loop: the throughput tracker (PR 4)
+already maintains per-client examples/sec EMAs, and the async
+admission buffer (PR 2/PR 10) already knows how to carry a
+partial-work slot across rounds and admit it later with a staleness
+discount — but nothing connected them, so a slow cohort-mate still
+dragged every round to its own pace. This controller closes that
+loop as a PURE HOST-SIDE MERGE on operands the traced programs
+already carry:
+
+  * at plan-stamp time it compares each measured active client's rate
+    EMA against the cohort median; clients slower than
+    ``ratio × median`` get a work fraction < 1 composed onto
+    plan.work (min-merge, exactly how deadline truncation rides);
+  * a work fraction < 1 on a surviving slot is precisely what the
+    async admission buffer defers into an ``--async_admit_rounds``
+    slot — so the slow client's contribution lands a round late with
+    the staleness discount instead of stalling its cohort;
+  * the ratio itself is the feedback knob: the observed deferred
+    fraction is nudged multiplicatively toward
+    ``--speed_match_target``, clamped to [speed_ratio_min,
+    speed_ratio_max] (max < 1, so a "slow" client is always strictly
+    slower than the median and its fraction strictly < 1).
+
+Replay-exactness is STRUCTURAL: rate EMAs are wall-clock-derived, so
+the adjustment runs at draw time on the fresh coordinator path ONLY —
+the stamped plan carries the post-adjustment ratio, followers and
+replayed rounds install() the plan's value, and a resumed run's ratio
+state is therefore a pure function of the journaled plan stream.
+The median-threshold rule also bounds the blast radius: at most half
+the measured cohort can ever sit strictly below ``ratio × median``
+(the ratio cap is < 1), so a round can never defer itself empty.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from commefficient_tpu.control.base import Adjustment, Controller
+
+__all__ = ["SpeedMatchController"]
+
+# floor on a deferred slot's work fraction: a barely-measured client
+# must still carry enough of its round for the late admit to matter
+_MIN_DEFER_FRAC = 0.25
+
+
+class SpeedMatchController(Controller):
+    """Defer measured-slow clients into async admission slots."""
+
+    NAME = "speed_match"
+    WIRE_FIELD = "speed_ratio"
+    STATE_KEYS = ("ratio", "rounds_observed")
+
+    def __init__(self, cfg):
+        self.target = float(cfg.speed_match_target)
+        self.step = float(cfg.speed_match_step)
+        self.lo = float(cfg.speed_ratio_min)
+        self.hi = float(cfg.speed_ratio_max)
+        self.ratio = self._f32(
+            min(max(float(cfg.speed_ratio), self.lo), self.hi))
+        self.rounds_observed = 0
+
+    def plan_value(self) -> float:
+        return self._f32(self.ratio)
+
+    def install(self, value) -> None:
+        self.ratio = float(value)
+
+    def stamp(self, round_idx: int, ids: np.ndarray, ex: np.ndarray,
+              tracker) -> Tuple[float, Optional[np.ndarray],
+                                Optional[Adjustment]]:
+        ex = np.asarray(ex, np.float64).reshape(-1)
+        ids = np.asarray(ids).reshape(-1)
+        active = ex > 0
+        rates = np.asarray(tracker.examples_per_sec(ids),
+                           np.float64).reshape(-1)
+        # 0.0 means "never measured" — an unmeasured client is never
+        # flagged slow (no evidence), and speed matching needs at
+        # least two measured rates for a meaningful median
+        measured = active & (rates > 0.0)
+        work = None
+        adj = None
+        if int(measured.sum()) >= 2:
+            med = float(np.median(rates[measured]))
+            if med > 0.0:
+                # the SIGNAL is the deferred fraction the current
+                # ratio would produce; observe first, then flag under
+                # the post-adjustment ratio so the stamped wire value
+                # and the stamped work fractions agree
+                slow = measured & (rates < self.plan_value() * med)
+                signal = (float(slow.sum())
+                          / float(max(int(active.sum()), 1)))
+                adj = self._observe(round_idx, signal)
+                slow = measured & (rates < self.plan_value() * med)
+                if bool(slow.any()):
+                    work = np.ones(len(ex), np.float32)
+                    frac = np.maximum(rates[slow] / med,
+                                      _MIN_DEFER_FRAC)
+                    work[slow] = frac.astype(np.float32)
+        return self.plan_value(), work, adj
+
+    def _observe(self, round_idx: int,
+                 signal: float) -> Optional[Adjustment]:
+        self.rounds_observed += 1
+        old = self.plan_value()
+        if signal > self.target:
+            # deferring too much of the cohort: tighten the slowness
+            # bar so fewer clients qualify
+            raw = old / (1.0 + self.step)
+            new, clamped = max(raw, self.lo), raw < self.lo
+        elif signal < self.target:
+            raw = old * (1.0 + self.step)
+            new, clamped = min(raw, self.hi), raw > self.hi
+        else:
+            return None
+        new = self._f32(new)
+        self.ratio = new
+        if new != old:
+            return Adjustment(self.NAME, int(round_idx), float(signal),
+                              old, new, bool(clamped))
+        return None

@@ -1,0 +1,155 @@
+"""Adaptive staleness-decay controller (ISSUE 20).
+
+The async admission buffer (PR 2/PR 10) discounts a deferred slot's
+late contribution by ``async_staleness_decay ** rounds_late`` — a
+static prior on how fast stale gradients rot. PR 13's measurement
+substrate computes the actual rot-rate proxy every round: the
+``estimate_residual`` metric, ``error_l2 / (error_l2 + update_l2)``,
+the fraction of each round's information the sketch left behind. A
+noisy estimate pipeline means stale work is built on an even shakier
+base, so this controller closes the loop:
+
+  * at round COMMIT the model feeds the round's estimate_residual;
+    residual above ``--staleness_target`` tightens the decay
+    (discount late work harder), below loosens it, multiplicative
+    steps clamped to [staleness_decay_min, staleness_decay_max];
+  * the adjusted decay rides the plan (`staleness_decay` wire field)
+    and the model applies the PLAN-CARRIED value to the admission
+    buffer at compose time — the discount each round actually uses is
+    digest-covered and follower-identical, never a process-local
+    read.
+
+The signal is DEVICE-DETERMINISTIC (a replayed round re-observes the
+identical residual), but commit-time state read at DRAW time is not
+automatically replay-safe: under the pipelined staging loop, "which
+rounds have committed when round r is drawn" depends on the span
+decomposition and on where a resume seam lands — both wall-clock.
+So the stamp is FIXED-LAG instead of live: each commit appends
+(round, decay) to a small ring, and the plan value for round r is
+the ring entry at ``r - lag``, where the lag is the config-derived
+worst case of how far staging runs ahead of commits (1 for the
+synchronous per-round loop — the pre-existing live semantics — up to
+2x the largest span under ``--pipeline``). The stamped trajectory is
+then a pure function of per-round committed signals, invariant to
+span decomposition and prefetch depth, which is what makes a
+pipelined crash-resume bit-exact (tests/test_control.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from commefficient_tpu.control.base import Adjustment, Controller
+
+__all__ = ["StalenessDecayController"]
+
+
+def _observe_lag(cfg) -> int:
+    """Rounds between a commit-time observation and the first stamped
+    plan allowed to see it. Must be >= the worst-case staging runahead
+    so the ring lookup never races a collect: 1 in the synchronous
+    per-round loop (draws and commits strictly alternate), the span
+    length for synchronous scanned staging (a whole span is drawn
+    before any of it commits), and twice that under --pipeline (the
+    next span stages while the previous one is still in flight)."""
+    pal = tuple(getattr(cfg, "span_palette", ()) or ())
+    if pal:
+        horizon = max(pal)
+    elif getattr(cfg, "scan_rounds", False):
+        horizon = max(int(getattr(cfg, "scan_span", 0)), 1)
+    else:
+        horizon = 1
+    return 2 * horizon if getattr(cfg, "pipeline", False) else horizon
+
+
+class StalenessDecayController(Controller):
+    """Tune the async admission staleness discount from the
+    estimate-residual metric."""
+
+    NAME = "staleness_decay"
+    WIRE_FIELD = "staleness_decay"
+    STATE_KEYS = ("decay", "rounds_observed", "ring")
+    # the telemetry metric observed at commit (telemetry/metrics.py)
+    SIGNAL = "estimate_residual"
+    # the ring advances at COLLECT time in span order (like the
+    # accountant), so a pipelined span checkpoint must carry the
+    # live-at-save state, not the dispatch-time snapshot
+    COMMIT_STATE = True
+
+    def __init__(self, cfg):
+        self.target = float(cfg.staleness_target)
+        self.step = float(cfg.staleness_step)
+        self.lo = float(cfg.staleness_decay_min)
+        self.hi = float(cfg.staleness_decay_max)
+        self.lag = _observe_lag(cfg)
+        # fold tail: the decay after the newest observed commit
+        self.decay = self._f32(
+            min(max(float(cfg.async_staleness_decay), self.lo),
+                self.hi))
+        self.init_decay = self.decay
+        self.rounds_observed = 0
+        # [n, 2] (round, decay-after-commit) pairs in round order —
+        # one per observed commit, pruned to the lookup horizon
+        self.ring = np.zeros((0, 2), np.float64)
+        # the value the last stamped/installed plan carried
+        self.stamped = self.decay
+
+    def plan_value(self) -> float:
+        return self._f32(self.stamped)
+
+    def install(self, value) -> None:
+        # the plan-carried value is what the round APPLIES (the model
+        # writes it into the admission buffer at compose time); the
+        # fold state advances only through observe_commit, which runs
+        # identically on followers and replayed rounds
+        self.stamped = float(value)
+
+    def _lagged(self, round_idx: int) -> float:
+        """Decay after the newest commit at or before
+        ``round_idx - lag`` (the initial value before any qualifies).
+        The lag guarantees that commit has always been observed by
+        draw time, on every engine path."""
+        k = int(round_idx) - self.lag
+        ring = np.asarray(self.ring, np.float64).reshape(-1, 2)
+        eligible = ring[ring[:, 0] <= k]
+        if len(eligible) == 0:
+            return self._f32(self.init_decay)
+        return self._f32(eligible[-1, 1])
+
+    def stamp(self, round_idx, ids, ex, tracker):
+        del ids, ex, tracker
+        self.stamped = self._lagged(round_idx)
+        return self.plan_value(), None, None
+
+    def observe_commit(self, round_idx: int,
+                       signals: dict) -> Optional[Adjustment]:
+        resid = signals.get(self.SIGNAL)
+        if resid is None:
+            return None
+        self.rounds_observed += 1
+        resid = float(resid)
+        old = self._f32(self.decay)
+        new, clamped = old, False
+        if resid > self.target:
+            # noisy estimates: stale deferred work is even less
+            # trustworthy — discount it harder
+            raw = old / (1.0 + self.step)
+            new, clamped = max(raw, self.lo), raw < self.lo
+        elif resid < self.target:
+            raw = old * (1.0 + self.step)
+            new, clamped = min(raw, self.hi), raw > self.hi
+        new = self._f32(new)
+        self.decay = new
+        # every observed commit gets a ring entry (adjusted or not),
+        # so the lagged lookup lands on exact rounds and pruning can
+        # never strand a lookup on the initial-value fallback
+        ring = np.asarray(self.ring, np.float64).reshape(-1, 2)
+        ring = np.concatenate(
+            [ring, [[float(int(round_idx)), new]]], axis=0)
+        keep = 4 * self.lag + 4
+        self.ring = ring[-keep:]
+        if new != old:
+            return Adjustment(self.NAME, int(round_idx), resid,
+                              old, new, bool(clamped))
+        return None

@@ -52,6 +52,7 @@ from commefficient_tpu.parallel.plantransport import (
     PlanDigestError, install_digest,
 )
 from commefficient_tpu.telemetry.clients import ClientThroughputTracker
+from commefficient_tpu.telemetry.metrics import METRIC_INDEX
 from commefficient_tpu.telemetry.trace import TRACE
 from commefficient_tpu.utils.faults import (
     FaultSchedule, InjectedFault, bernoulli_survivors, byzantine_mask,
@@ -278,6 +279,19 @@ class FedModel:
             )
             self.screen_ctl = AdaptiveScreenController(cfg)
         self._plan_screen_mult = {}
+        # plan-riding controller bank (ISSUE 20, control/): None by
+        # default — make_bank constructs one exactly when a bank
+        # controller flag is set. attach_scheduler shares it so the
+        # fresh coordinator path stamps every sealed plan through it;
+        # _plan_controls stashes each consumed plan's `controls` dict
+        # per round — the plan-carried values WIN over local state
+        # (install) and the stashed staleness_decay is applied to the
+        # async admission buffer at compose time, so the discount a
+        # round executes with is exactly the digest-covered journaled
+        # one.
+        from commefficient_tpu.control import make_bank
+        self.control_bank = make_bank(cfg)
+        self._plan_controls = {}
         # observability (telemetry/): the throughput tracker always
         # exists (cheap arrays; its state rides in every checkpoint so
         # resume restores it even for runs that never journal), while
@@ -375,6 +389,11 @@ class FedModel:
             # its is_default goes False, so plans exist to carry it)
             if self.screen_ctl is not None:
                 scheduler.screen_ctl = self.screen_ctl
+            # controller bank (ISSUE 20): same sharing contract — the
+            # scheduler stamps fresh plans through the bank and its
+            # is_default goes False so plans exist to carry the values
+            if self.control_bank is not None:
+                scheduler.control_bank = self.control_bank
 
     def scheduler_state(self) -> Optional[dict]:
         """The `sched_*` checkpoint payload: the attached scheduler's
@@ -770,6 +789,17 @@ class FedModel:
                 # controller's value (_screen_flag pops this)
                 self._plan_screen_mult[int(round_idx)] = float(
                     plan.screen_mult)
+            if plan.controls:
+                # controller bank (ISSUE 20): the plan-carried values
+                # are the authoritative trajectory — stash them for
+                # compose-time application (staleness decay) and
+                # install them as the bank's live state, so followers,
+                # replayed rounds, and takeover coordinators all run
+                # the journaled decision instead of recomputing one
+                self._plan_controls[int(round_idx)] = dict(
+                    plan.controls)
+                if self.control_bank is not None:
+                    self.control_bank.install(plan.controls)
             # journaling is deferred to _seal_plan (ISSUE 12): the
             # `schedule` event must carry the digest of the FULLY
             # composed decision (async admits land after this pass)
@@ -960,6 +990,49 @@ class FedModel:
                 old_mult=round(old, 6), new_mult=round(new, 6),
                 rate=round(rate, 6),
                 target=float(self.cfg.target_screened_rate))
+
+    # -- plan-riding controller bank (ISSUE 20) --------------------------
+    @staticmethod
+    def _control_signals(row) -> dict:
+        """Commit-time signal dict for ControllerBank.observe_commit
+        from one materialized [NUM_METRICS] telemetry row (or {} when
+        metrics are off — controllers then skip the observation)."""
+        if row is None or getattr(row, "size", 0) == 0:
+            return {}
+        row = np.asarray(row, np.float32)
+        return {"estimate_residual": float(
+            row[METRIC_INDEX["estimate_residual"]])}
+
+    def _journal_control_events(self) -> None:
+        """Drain the bank's queued adjustments into `control` journal
+        events — the single journaling seam for draw-time (stamp),
+        commit-time (observe_commit), and span (feed_span)
+        adjustments alike."""
+        if self.control_bank is None:
+            return
+        events = self.control_bank.take_events()
+        if self.telemetry is None:
+            return
+        for adj in events:
+            self.telemetry.journal_event(
+                "control", round=int(adj.round_idx),
+                controller=str(adj.controller),
+                signal=round(float(adj.signal), 6),
+                old=round(float(adj.old), 6),
+                new=round(float(adj.new), 6),
+                clamped=bool(adj.clamped))
+
+    def _apply_plan_controls(self, round_idx: int) -> None:
+        """Apply one consumed plan's stashed controller values to the
+        operands the round is about to compose with — currently the
+        async admission buffer's staleness decay. Runs BEFORE
+        async_admit.compose so the defer/admit weights this round
+        journals and digests use exactly the plan-carried discount."""
+        controls = self._plan_controls.pop(int(round_idx), None)
+        if (controls and self.async_admit is not None
+                and "staleness_decay" in controls):
+            self.async_admit.decay = float(
+                np.float32(controls["staleness_decay"]))
 
     # -- reference API surface -------------------------------------------
     def train(self, training: bool):
@@ -1189,6 +1262,7 @@ class FedModel:
         with TRACE.span("plan", round=this_round):
             survivors, work = self._faults_for_round(this_round,
                                                      client_ids)
+            self._apply_plan_controls(this_round)
             admits = ()
             if self.async_admit is not None:
                 # buffered async aggregation (federated/async_agg):
@@ -1356,6 +1430,18 @@ class FedModel:
         if self.screen_ctl is not None and n_screened is not None:
             self._observe_screening(this_round, n_screened,
                                     staged.survivors)
+        # controller bank (ISSUE 20): commit-time observation on the
+        # round's device-deterministic metric row (a replayed round
+        # re-observes identically), then drain every queued
+        # adjustment — draw-time stamps included — into `control`
+        # journal events. The device_get is a sync, but only
+        # bank-enabled configs ever take it.
+        if self.control_bank is not None:
+            self.control_bank.observe_commit(
+                this_round, self._control_signals(
+                    jax.device_get(metrics.telemetry)
+                    if self.cfg.telemetry else None))
+            self._journal_control_events()
         # compressor + privacy journaling (ISSUE 19): per committed
         # round, after accounting so up_bytes is this round's billed
         # total. _journal_privacy raises once the epsilon budget is
@@ -1517,6 +1603,7 @@ class FedModel:
                 for n in range(n_rounds):
                     s, w = self._faults_for_round(first + n,
                                                   ids_host[n])
+                    self._apply_plan_controls(first + n)
                     admits = ()
                     if self.async_admit is not None:
                         row_ids = ids_host[n]
@@ -1835,9 +1922,11 @@ class FedModel:
                       for n in range(ids_host.shape[0])]
         if all(r is None for r in sched_rows):
             sched_rows = None
-        if self.telemetry is not None:
+        tele_rows = None
+        if self.telemetry is not None or self.control_bank is not None:
             tele_rows = (mh.gather_host(metrics.telemetry)
                          if self.cfg.telemetry else None)
+        if self.telemetry is not None:
             counts_rows = mh.gather_host(metrics.num_examples)
             self.telemetry.on_span(
                 first, ids_host, tele_rows, counts_rows,
@@ -1857,6 +1946,27 @@ class FedModel:
                 for q in self.state_store.take_quarantine_events():
                     self.telemetry.journal_event(
                         "state_quarantine", first_round=first, **q)
+
+        # controller bank (ISSUE 20): per-round commit observation on
+        # the span's materialized metric rows (deterministic — a
+        # replayed span re-observes identically), then the span-
+        # cadence feed with the span's realized wall time (dispatch +
+        # device execute; wall-clock, so its adjustments only ever
+        # ride FUTURE fresh plans), then one drain of every queued
+        # adjustment into `control` journal events — before the
+        # injected-crash boundary below, matching the unscanned path
+        # where committed rounds journal their adjustments before the
+        # crash raises.
+        if self.control_bank is not None:
+            n_committed = int(ids_host.shape[0])
+            for n in range(n_committed):
+                self.control_bank.observe_commit(
+                    first + n, self._control_signals(
+                        None if tele_rows is None else tele_rows[n]))
+            self.control_bank.feed_span(
+                first + n_committed - 1, n_committed,
+                float(t_blocked - handle.t_dispatch0))
+            self._journal_control_events()
 
         if crash_at is not None:
             # every completed round's state/accounting landed above —

@@ -120,6 +120,15 @@ def serialize_plan(plan) -> bytes:
         # non-adaptive run's wire bytes (and their sha256 plan
         # identities) stay byte-identical to a pre-17 build
         obj["screen_mult"] = float(np.float32(plan.screen_mult))
+    if plan.controls:
+        # controller bank (ISSUE 20): conditional for the same
+        # reason. Ints (span picks) ride exact; floats are f32-rounded
+        # at stamp time and float() round-trips them bit-exactly
+        # through JSON, so install == stamp on every controller.
+        obj["controls"] = {
+            str(k): (int(v) if isinstance(v, (int, np.integer))
+                     else float(np.float32(v)))
+            for k, v in plan.controls.items()}
     return json.dumps(obj, sort_keys=True,
                       separators=(",", ":")).encode()
 
@@ -145,7 +154,8 @@ def deserialize_plan(payload: bytes):
         obj.get("deadline_s"), obj.get("est_round_s"),
         obj.get("expected_round_s"), str(obj["sampler"]),
         arr("participants", np.int64),
-        screen_mult=obj.get("screen_mult"))
+        screen_mult=obj.get("screen_mult"),
+        controls=obj.get("controls"))
 
 
 def payload_digest(payload: bytes) -> str:
@@ -546,6 +556,20 @@ class MirroredControllers:
         # ever calls observe(), so sharing one instance is safe.
         for s in self.schedulers:
             s.screen_ctl = ctl
+
+    @property
+    def control_bank(self):
+        return self._coord.control_bank
+
+    @control_bank.setter
+    def control_bank(self, bank) -> None:
+        # controller bank (ISSUE 20): same sharing contract as
+        # screen_ctl — the coordinator stamps plans through the bank,
+        # followers' is_default goes False so they install the
+        # broadcast. Only the model feeds observations and drains
+        # adjustment events, so one shared instance is safe here too.
+        for s in self.schedulers:
+            s.control_bank = bank
 
     def begin_epoch(self, first_round: int) -> None:
         self._pending_select = None

@@ -63,6 +63,9 @@ from typing import Dict, NamedTuple, Optional
 
 import numpy as np
 
+# the adaptive screen controller migrated to control/screen.py
+# (ISSUE 20) — re-exported here so existing imports keep working
+from commefficient_tpu.control.screen import AdaptiveScreenController
 from commefficient_tpu.scheduler.deadline import (
     DeadlineDecision, DeadlinePolicy, overprovision,
 )
@@ -113,6 +116,13 @@ class RoundPlan(NamedTuple):
     # REPLAYED, not recomputed, on a deterministic restart or
     # takeover. None whenever adaptive screening is off.
     screen_mult: Optional[float] = None
+    # plan-riding controller values (ISSUE 20): {wire_field: value}
+    # stamped by the ControllerBank on the fresh coordinator path.
+    # Every key must be registered in analysis/domains.CONTROL_FIELDS
+    # (graftlint GL014). Serialized conditionally — None keeps the
+    # wire bytes byte-identical to a pre-20 plan — and installed
+    # (never recomputed) by followers and replayed rounds.
+    controls: Optional[dict] = None
 
     def journal_fields(self) -> dict:
         """Payload of the `schedule` journal event (None fields
@@ -127,92 +137,11 @@ class RoundPlan(NamedTuple):
             out["truncated_slots"] = int((self.work < 1.0).sum())
         if self.screen_mult is not None:
             out["screen_mult"] = float(self.screen_mult)
+        if self.controls:
+            for field, value in sorted(self.controls.items()):
+                out[field] = (int(value) if isinstance(value, int)
+                              else float(value))
         return out
-
-
-class AdaptiveScreenController:
-    """Closed-loop tuner for the norm-screen threshold (ISSUE 17).
-
-    PR 16's update screening rejects client updates whose l2 norm
-    exceeds ``screen_norm_mult`` times the cohort median — a STATIC
-    multiplier, so an operator has to guess how aggressive the screen
-    should be before seeing the run. This controller closes the loop:
-    it watches the journaled per-round screened rate and nudges the
-    multiplier multiplicatively toward ``--target_screened_rate``
-    (observed rate above target → loosen, below → tighten), clamped to
-    [screen_mult_min, screen_mult_max].
-
-    Determinism contract: every adjustment is pure f32 arithmetic on
-    journal-materialized integer counts — no wall clock, no RNG — and
-    the multiplier each round dispatches with RIDES THE ROUNDPLAN
-    (``RoundPlan.screen_mult``), coordinator-broadcast under
-    ``--plan_transport`` and replayed (not recomputed) from the
-    write-ahead journal on a restart or takeover. The traced program
-    never changes: the screen operand PR 16 already threads into the
-    jitted round carries the live multiplier as its VALUE, and its
-    plan-digest coverage (install_digest's screen_on field) extends to
-    the multiplier for free. ``screen_mult_min`` must stay > 1 so the
-    adapted value can never collide with the screen-off sentinel 0.
-
-    One instance per run, created by FedModel and shared with the
-    RoundScheduler (attach_scheduler): the model consults it for
-    transport-free dispatch, the scheduler stamps it into broadcast
-    plans. Its state rides the scheduler's sched_* checkpoint keys so
-    a resumed run continues the trajectory bit-exactly.
-    """
-
-    STATE_KEYS = ("screen_mult", "screen_rounds_observed")
-
-    def __init__(self, cfg):
-        self.target = float(cfg.target_screened_rate)
-        self.step = float(cfg.screen_adapt_step)
-        self.lo = float(cfg.screen_mult_min)
-        self.hi = float(cfg.screen_mult_max)
-        self.mult = float(np.float32(
-            min(max(float(cfg.screen_norm_mult), self.lo), self.hi)))
-        self.rounds_observed = 0
-
-    def plan_mult(self) -> float:
-        """The multiplier the NEXT round dispatches with — f32-rounded
-        so the journaled plan, the install digest, and the traced
-        screen operand all carry the identical value."""
-        return float(np.float32(self.mult))
-
-    def observe(self, round_idx: int, n_screened: int,
-                n_cohort: int) -> Optional[tuple]:
-        """Feed one committed round's observed screened count (EVERY
-        round, zero included — the controller's trajectory is a pure
-        function of the observation stream, so skipping quiet rounds
-        would desync a resumed run). Returns (old_mult, new_mult,
-        rate) when the threshold moved, else None."""
-        del round_idx  # trajectory is stream-positional, not indexed
-        self.rounds_observed += 1
-        rate = float(n_screened) / float(max(int(n_cohort), 1))
-        old = self.plan_mult()
-        if rate > self.target:
-            new = min(old * (1.0 + self.step), self.hi)
-        elif rate < self.target:
-            new = max(old / (1.0 + self.step), self.lo)
-        else:
-            new = old
-        new = float(np.float32(new))
-        self.mult = new
-        if new != old:
-            return (old, new, rate)
-        return None
-
-    def state_dict(self) -> dict:
-        return {"screen_mult": np.float64(self.mult),
-                "screen_rounds_observed": np.int64(
-                    self.rounds_observed)}
-
-    def load_state_dict(self, state: dict) -> None:
-        # legacy checkpoints (pre-17) carry no controller keys: keep
-        # the config-derived start point
-        if "screen_mult" in state:
-            self.mult = float(np.asarray(state["screen_mult"]))
-            self.rounds_observed = int(np.asarray(
-                state.get("screen_rounds_observed", 0)))
 
 
 class RoundScheduler:
@@ -278,6 +207,13 @@ class RoundScheduler:
         # plans every round for the threshold to ride the journal /
         # broadcast). None keeps every path identical to pre-17.
         self.screen_ctl = None
+        # plan-riding controller bank (ISSUE 20): FedModel.
+        # attach_scheduler shares the run's ControllerBank here so
+        # commit_round stamps every fresh coordinator plan through it
+        # (draw-time observation, work composition, controls wire
+        # fields) and its state rides the sched_* checkpoint keys.
+        # None keeps every path identical to pre-20.
+        self.control_bank = None
         self._last_selected: Optional[np.ndarray] = None
         self._received: Optional[RoundPlan] = None
         # deterministic-restart replay (ISSUE 12): {round: serialized
@@ -367,7 +303,8 @@ class RoundScheduler:
         return (isinstance(self.policy, UniformSampler)
                 and self.deadline is None
                 and self.target_survivors == 0
-                and self.screen_ctl is None)
+                and self.screen_ctl is None
+                and self.control_bank is None)
 
     # ---------------- selection side (FedSampler) ------------------------
     def begin_epoch(self, first_round: int) -> None:
@@ -526,6 +463,14 @@ class RoundScheduler:
             # value and a restart replays the journaled one
             plan = plan._replace(
                 screen_mult=self.screen_ctl.plan_mult())
+        if self.control_bank is not None:
+            # controller bank stamp (ISSUE 20): draw-time observation
+            # runs HERE and only here — the fresh coordinator path —
+            # so every wall-clock-derived adjustment is sealed into
+            # the plan before it is journaled/broadcast, and every
+            # other path (follower, replay) installs instead
+            plan = self.control_bank.stamp_plan(plan, ids, ex,
+                                                self.tracker)
         self._last_selected = None
         if self.transport is not None:
             # coordinator broadcast: serialize, send once, and install
@@ -593,6 +538,11 @@ class RoundScheduler:
         # a resumed run continues the threshold trajectory bit-exactly
         if self.screen_ctl is not None:
             out.update(self.screen_ctl.state_dict())
+        # controller-bank state rides along (ISSUE 20): ctl_<name>_*
+        # keys in the same sched_* namespace, same bit-exact-resume
+        # contract
+        if self.control_bank is not None:
+            out.update(self.control_bank.state_dict())
         return out
 
     def load_state_dict(self, state: dict) -> None:
@@ -611,6 +561,8 @@ class RoundScheduler:
             self.policy.load_state_dict(state)
         if self.screen_ctl is not None:
             self.screen_ctl.load_state_dict(state)
+        if self.control_bank is not None:
+            self.control_bank.load_state_dict(state)
 
 
 def attach_round_scheduler(model, train_loader) -> RoundScheduler:

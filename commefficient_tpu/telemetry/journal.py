@@ -90,6 +90,14 @@ consumers must tolerate kinds they don't know):
                           multiplier moved — `round`, `old_mult`,
                           `new_mult`, `rate` (observed screened
                           fraction), `target`
+  control                 controller bank (ISSUE 20, control/): one
+                          plan-riding controller adjusted its value —
+                          `round`, `controller` (a name registered in
+                          analysis.domains.CONTROL_FIELDS), `signal`
+                          (the observed metric), `old`, `new`,
+                          `clamped` (the bound bit). The trajectory a
+                          crash-resume/takeover replay must reproduce
+                          bit-exactly from the plan stream
   numeric_trip            the finite-frontier watch tripped: a
                           watched telemetry metric (update_l2 /
                           error_l2) went non-finite — `round`,
@@ -151,6 +159,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from commefficient_tpu.analysis.domains import CONTROL_FIELDS
 from commefficient_tpu.telemetry.trace import (
     TRACE, device_busy_wall, stage_stats,
 )
@@ -731,6 +740,31 @@ def validate_journal(path: str,
                     problems.append(
                         f"record {n}: screen_adapt `{field}` must be "
                         f"a positive number (got {v2!r})")
+        if rec.get("event") == "control":
+            # controller bank (ISSUE 20): the plan-riding adjustment
+            # trajectory the replay-exactness drills compare, so the
+            # shape — and the controller name's registration in
+            # analysis.domains.CONTROL_FIELDS — must not rot
+            if not isinstance(rec.get("round"), int):
+                problems.append(
+                    f"record {n}: control event without an integer "
+                    f"`round` (got {rec.get('round')!r})")
+            c2 = rec.get("controller")
+            if not (isinstance(c2, str) and c2 in CONTROL_FIELDS):
+                problems.append(
+                    f"record {n}: control `controller` must be a "
+                    f"name registered in analysis.domains."
+                    f"CONTROL_FIELDS (got {c2!r})")
+            for field in ("signal", "old", "new"):
+                v2 = rec.get(field)
+                if not isinstance(v2, (int, float)):
+                    problems.append(
+                        f"record {n}: control `{field}` must be "
+                        f"numeric (got {v2!r})")
+            if not isinstance(rec.get("clamped"), bool):
+                problems.append(
+                    f"record {n}: control `clamped` must be a bool "
+                    f"(got {rec.get('clamped')!r})")
         if rec.get("event") == "privacy":
             # differential privacy (ISSUE 19): the budget record the
             # tier1 dp smoke's monotone-epsilon gate reads, so its
@@ -980,6 +1014,7 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
     epsilon_spent = None
     privacy_sigma = privacy_delta = None
     wire_by_mode: dict = {}
+    control_by_ctl: dict = {}
     # trace spans SEGMENTED at run_start: monotonic t0 values share a
     # base only within one process lifetime, so the wall-extent math
     # (overlap efficiency) must never mix segments from a resumed run
@@ -1045,6 +1080,19 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
                 acc["up_bytes"] += float(ub)
                 if isinstance(rec.get("wire_bytes"), (int, float)):
                     acc["wire_bytes"] = float(rec["wire_bytes"])
+        if kind == "control":
+            c2 = rec.get("controller")
+            if isinstance(c2, str) and c2:
+                acc = control_by_ctl.setdefault(
+                    c2, {"adjustments": 0, "clamped": 0,
+                         "final": None})
+                acc["adjustments"] += 1
+                if rec.get("clamped") is True:
+                    acc["clamped"] += 1
+                if isinstance(rec.get("new"), (int, float)):
+                    # records are appended in commit order, so the
+                    # last `new` IS the controller's final value
+                    acc["final"] = float(rec["new"])
         if kind == "state_tier":
             tier_hits += int(rec.get("hits", 0) or 0)
             tier_misses += int(rec.get("misses", 0) or 0)
@@ -1112,6 +1160,16 @@ def summarize(records: List[dict], corrupt_lines: int = 0) -> dict:
                 "wire_bytes": round(acc["wire_bytes"], 3),
                 "up_mib": round(acc["up_bytes"] / (1024 ** 2), 3)}
             for m, acc in sorted(wire_by_mode.items())}
+    if control_by_ctl:
+        # controller bank (ISSUE 20): per-controller adjustment count,
+        # clamp count, and final value — the one-line answer to "what
+        # did the self-tuning loop actually do this run"
+        out["controllers"] = {
+            c: {"adjustments": acc["adjustments"],
+                "clamped": acc["clamped"],
+                "final": (None if acc["final"] is None
+                          else round(acc["final"], 6))}
+            for c, acc in sorted(control_by_ctl.items())}
     if tier_hits or tier_misses:
         # tiered client state (ISSUE 11): working-set hit rate +
         # spill traffic — the run's residency summary line

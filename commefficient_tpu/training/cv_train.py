@@ -274,7 +274,11 @@ def train(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
             run_scanned_rounds(
                 model, stream(),
-                cfg.scan_span if cfg.scan_span > 0 else epoch_rounds,
+                # palette mode hands the controller bank in as the
+                # adaptive span provider; static --scan_span otherwise
+                model.control_bank if cfg.span_palette
+                else (cfg.scan_span if cfg.scan_span > 0
+                      else epoch_rounds),
                 scan_emit, on_comm, on_flush=on_flush,
                 # span-boundary saves bound a mid-span preemption's
                 # loss to ckpt_every_spans spans, not one epoch

@@ -263,7 +263,10 @@ def train_gpt2(model: FedModel, opt: FedOptimizer, lr_scheduler,
 
             aborted = not run_scanned_rounds(
                 model, stream(),
-                cfg.scan_span if cfg.scan_span > 0 else spe,
+                # palette mode hands the controller bank in as the
+                # adaptive span provider; static --scan_span otherwise
+                model.control_bank if cfg.span_palette
+                else (cfg.scan_span if cfg.scan_span > 0 else spe),
                 lambda tag, l_, lm_, mc_: emit(
                     (tag[0], tag[1], l_, lm_, mc_)),
                 on_comm,

@@ -28,7 +28,7 @@ import numpy as np
 
 
 def run_scanned_rounds(model, stream: Iterable[Tuple],
-                       span_cap: int,
+                       span_cap,
                        emit: Callable[..., bool],
                        on_comm: Optional[Callable[[np.ndarray, np.ndarray],
                                                   None]] = None,
@@ -40,6 +40,17 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
     """Drive scanned spans over `stream`, which yields
     (tag, client_ids, data_tuple, mask, lr) per round — the caller owns
     round-budget/epoch-boundary logic by just ending the stream.
+
+    `span_cap` is either a static int (the pre-ISSUE-20 contract) or
+    an adaptive provider exposing `span_cap(default) -> int` and
+    `tail_cap(leftover) -> int` (the model's ControllerBank when a
+    span-cadence controller is attached). Adaptive mode latches the
+    provider's live pick at each span's START — a mid-span adjustment
+    (a collect-time cadence feed, a replayed plan's install) can only
+    ever resize the NEXT span, so a flush never stages an off-palette
+    (untraced) shape — and decomposes the stream tail greedily over
+    `tail_cap`, largest already-traced length first, down to the
+    guaranteed 1-span (Config.validate requires 1 in the palette).
 
     Per flushed span: on_flush(n_rounds) once as soon as the span's
     device program has returned (per-round wall-time attribution — a
@@ -197,21 +208,43 @@ def run_scanned_rounds(model, stream: Iterable[Tuple],
         pending.append((handle, list(tags), span_idx, snap))
         return prev_ok
 
+    adaptive = hasattr(span_cap, "span_cap")
+    cap = None
     for tag, client_ids, data, mask, lr in stream:
+        if cap is None:
+            cap = (int(span_cap.span_cap(1)) if adaptive
+                   else int(span_cap))
         ids.append(client_ids)
         datas.append(data)
         masks.append(mask)
         lrs.append(lr)
         tags.append(tag)
-        if len(ids) == span_cap:
+        if len(ids) == cap:
             if not flush():
                 drain_pending_on_abort()
                 return False
             ids, datas, masks, lrs, tags = [], [], [], [], []
-    if ids:
+            cap = None
+    while ids:
+        # stream tail: static mode flushes the leftover as one span
+        # (its own traced shape, as before); adaptive mode decomposes
+        # it over already-traced palette lengths
+        take = (max(1, min(int(span_cap.tail_cap(len(ids))),
+                           len(ids)))
+                if adaptive else len(ids))
+        rest = None
+        if take < len(ids):
+            rest = (ids[take:], datas[take:], masks[take:],
+                    lrs[take:], tags[take:])
+            ids, datas, masks, lrs, tags = (
+                ids[:take], datas[:take], masks[:take], lrs[:take],
+                tags[:take])
         if not flush():
             drain_pending_on_abort()
             return False
+        if rest is None:
+            break
+        ids, datas, masks, lrs, tags = rest
     if pending:
         return collect_pending()
     return True
@@ -327,6 +360,19 @@ def make_span_checkpoint(prefix: str, model, cfg, lr_scheduler):
             return
         if snapshot is None:
             snapshot = take_snapshot()
+        bank = getattr(model, "control_bank", None)
+        if bank is not None:
+            commit_keys = bank.commit_state_dict()
+            if commit_keys:
+                # commit-time controller state (the staleness ring)
+                # advances at COLLECT time in span order — by save
+                # time this span HAS collected, so the live read is
+                # the span-consistent one; the dispatch-time snapshot
+                # predates the previous span's collect under
+                # --pipeline (same discipline as the accountant and
+                # _prev_change_words above)
+                snapshot["scheduler"] = {**snapshot["scheduler"],
+                                         **commit_keys}
         t0 = time.monotonic()
         # graftscope (ISSUE 13): the boundary save as a `checkpoint`
         # stage span (gather + serialize, or gather + enqueue under

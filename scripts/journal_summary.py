@@ -32,6 +32,13 @@ reassociation bounds block (non-negative ints per program); the
 summary surfaces the digests (`analysis_digests`) and finding count
 (`num_audit_findings`) so a CI run records which numerics contract it
 was green against.
+ISSUE 20 (control/): `control` events — one per controller-bank
+adjustment — are schema-checked (integer `round`, `controller`
+registered in analysis.domains.CONTROL_FIELDS, numeric
+`signal`/`old`/`new`, boolean `clamped`), and the summary grows a
+`controllers` block with per-controller adjustment/clamp counts and
+the final value, so the tier1 self-tuning smoke can gate on "every
+controller actually moved" from one summary read.
 
 Usage:
     python scripts/journal_summary.py <journal.jsonl> [--quiet]

@@ -250,6 +250,51 @@ print(f"TRACE_GATE_OK stages={len(names)} threads={len(threads)} "
       f"overlap_efficiency={oe}")
 PYEOF
 
+  # self-tuning control smoke (ISSUE 20): the pipelined smoke's
+  # heavy-straggler load with all three feedback controllers live —
+  # cohort speed matching (--speed_match), adaptive span cadence
+  # (--scan_span_palette, spans retraced once at warmup then picked
+  # from the palette), and adaptive staleness decay
+  # (--adapt_staleness, fixed-lag stamped from the estimate-residual
+  # metric). Gates: the journal validates (control event schema),
+  # summarize() shows >= 1 journaled adjustment for EACH controller
+  # (a silently-inert controller fails), and the steady-state loop
+  # journals zero compile_warning — the palette's span programs all
+  # traced at warmup, so adaptation costs no recompiles.
+  JR12=/tmp/_t1_journal_control.jsonl
+  rm -f "$JR12"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m commefficient_tpu.training.cv_train \
+      --test --dataset_name CIFAR10 --mode uncompressed \
+      --local_momentum 0.0 --num_workers 8 --local_batch_size 8 \
+      --num_epochs 0.5 --valid_batch_size 16 --lr_scale 0.1 \
+      --scan_rounds --scan_span_palette 1,2 --pipeline \
+      --sampler throughput --async_admit_rounds 1 \
+      --speed_match --adapt_staleness \
+      --straggler_rate 0.6 --straggler_min_work 0.4 \
+      --journal_path "$JR12" --dataset_dir /tmp/_t1_ds >/dev/null 2>&1 \
+      || { echo "CONTROL_SMOKE_FAILED"; exit 1; }
+  python scripts/journal_summary.py "$JR12" \
+      || { echo "CONTROL_JOURNAL_INVALID"; exit 1; }
+  check_no_recompiles "$JR12" || { echo "CONTROL_RECOMPILE"; exit 1; }
+  python - "$JR12" <<'PYEOF' || { echo "CONTROL_GATE_FAILED"; exit 1; }
+import sys
+sys.path.insert(0, ".")
+from commefficient_tpu.telemetry.journal import summarize, validate_journal
+records, problems = validate_journal(sys.argv[1])
+assert not problems, problems
+ctls = summarize(records).get("controllers", {})
+want = {"speed_match", "span_cadence", "staleness_decay"}
+assert set(ctls) >= want, \
+    f"controllers missing from journal: {sorted(want - set(ctls))}"
+inert = [n for n in want if ctls[n]["adjustments"] < 1]
+assert not inert, f"controller(s) never adjusted: {inert}"
+print("CONTROL_GATE_OK " + " ".join(
+    f"{n}={ctls[n]['adjustments']}/{ctls[n]['final']}"
+    for n in sorted(want)))
+PYEOF
+
   # multi-controller control-plane smoke (ISSUE 12): the scheduled
   # scanned run under the EMULATED N-controller plan transport —
   # throughput sampling + async admission, every round's plan

@@ -1,0 +1,776 @@
+"""control/ — plan-riding feedback controllers (ISSUE 20).
+
+The contracts proven here:
+
+  * UNIT BEHAVIOR — each controller's observe/adjust arithmetic is
+    bounded (multiplicative steps, f32-rounded, clamped with the
+    clamp bit reported), the speed matcher can never defer half the
+    measured cohort (median-threshold rule), the span controller's
+    warmup cycles every palette entry exactly once before the argmin
+    EMA picks, and the stream-tail decomposition only ever produces
+    already-traced palette lengths.
+  * THE BANK IS THE GATE — unregistered wire fields and field
+    collisions fail construction loudly (the runtime twin of the
+    CONTROL_FIELDS import-time assert and graftlint GL014); work
+    fractions min-compose onto the plan; state round-trips through
+    the ctl_<name>_<key> checkpoint namespace.
+  * SCREEN MIGRATION IS BEHAVIOR-IDENTICAL — the migrated
+    AdaptiveScreenController is the SAME class the scheduler package
+    re-exports, reproduces the pre-migration golden screen_mult
+    trajectory bit-for-bit (the f32 step/clamp arithmetic frozen by
+    PR 17), and keeps the legacy unprefixed checkpoint keys so
+    pre-20 checkpoints restore.
+  * REPLAY, NEVER RECOMPUTE — crash->resume (per-round path) and an
+    emulated coordinator takeover (transport path) reproduce
+    bit-identical weights AND the identical per-controller
+    adjustment trajectory; the span-cadence controller does the same
+    under the pipelined scanned path (--pipeline prefetch live at
+    the crash), where weights are span-decomposition-invariant by
+    construction.
+  * DEFAULTS ARE INERT — no controller flag => make_bank returns
+    None, plans carry no `controls` key, and the serialized wire
+    bytes are byte-identical to a pre-20 plan.
+"""
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.control import (
+    Adjustment, AdaptiveScreenController, Controller, ControllerBank,
+    SpanCadenceController, SpeedMatchController,
+    StalenessDecayController, make_bank,
+)
+from commefficient_tpu.data.sampler import FedSampler
+from commefficient_tpu.federated.api import FedModel, FedOptimizer
+from commefficient_tpu.parallel.plantransport import (
+    attach_emulated_cluster, deserialize_plan, serialize_plan,
+)
+from commefficient_tpu.scheduler import RoundPlan, RoundScheduler
+from commefficient_tpu.telemetry import RunJournal, TelemetrySession
+from commefficient_tpu.telemetry.journal import summarize, validate_journal
+from commefficient_tpu.utils.checkpoint import load_latest, save_rotating
+from commefficient_tpu.utils.faults import FaultSchedule, InjectedFault
+
+pytestmark = pytest.mark.control
+
+D = 8
+W = 8
+B = 4
+NC = 16
+
+
+def loss_fn(params, batch, mask):
+    x, y = batch
+    pred = x @ params["w"]
+    per_ex = 0.5 * (pred - y) ** 2
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / denom
+    return loss, (loss,)
+
+
+def _cfg(**kw):
+    base = dict(mode="uncompressed", grad_size=D, weight_decay=0.0,
+                num_workers=W, local_momentum=0.0, virtual_momentum=0.9,
+                error_type="none", microbatch_size=-1, num_clients=NC,
+                sampler="throughput")
+    base.update(kw)
+    return Config(**base).validate()
+
+
+CTL_KW = dict(speed_match=True, adapt_staleness=True,
+              async_admit_rounds=1, straggler_rate=0.5,
+              straggler_min_work=0.4)
+
+
+def _fed_model(cfg):
+    model = FedModel(None, loss_fn, cfg, params={"w": jnp.zeros(D)})
+    opt = FedOptimizer(model)
+    opt.param_groups[0]["lr"] = 0.1
+    return model, opt
+
+
+def _client_pool(seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(D).astype(np.float32)
+    x = rng.randn(NC, B, D).astype(np.float32)
+    y = np.einsum("cbd,d->cb", x, w_true).astype(np.float32)
+    return x, y
+
+
+class _Loader:
+    def __init__(self, sampler):
+        self.sampler = sampler
+
+
+def _sampler():
+    return FedSampler(np.full(NC, B), W, B, seed=7)
+
+
+def _attach_single(model):
+    smp = _sampler()
+    sched = RoundScheduler(model.cfg, model.num_clients,
+                           model.throughput)
+    smp.scheduler = sched
+    model.attach_scheduler(sched)
+    model.attach_data_sampler(smp)
+    return smp
+
+
+def _attach_emulated(model, num=3, schedule=None, network=None,
+                     coordinator=0):
+    smp = _sampler()
+    mirror, net = attach_emulated_cluster(
+        model, _Loader(smp), num_controllers=num,
+        coordinator=coordinator, schedule=schedule, network=network)
+    return smp, mirror, net
+
+
+def _feed_split(model, ids_arr, mask, done):
+    """Deterministic TWO-SPEED tracker feed: the first half of the
+    cohort's slots report 1s rounds, the second half 4s — a pure
+    function of slot position, identical across arms/resumes, and
+    guaranteed to give the speed matcher a real rate spread."""
+    del done
+    ex = mask.sum(axis=1)
+    half = ids_arr.shape[0] // 2
+    model.throughput.update_round(ids_arr[:half], ex[:half], 1.0)
+    model.throughput.update_round(ids_arr[half:], ex[half:], 4.0)
+
+
+def _drive(model, smp, pool, total_rounds, start=0,
+           save_after=None, ckpt_prefix=None):
+    x, y = pool
+    done = start
+    ids_log = []
+    while done < total_rounds:
+        if model.scheduler is not None:
+            model.scheduler.begin_epoch(done)
+        for ids, idx, mask in smp.epoch():
+            ids_arr = np.asarray(ids)
+            bx = x[ids_arr[:, None], idx]
+            by = y[ids_arr[:, None], idx]
+            model((ids_arr, (bx, by), mask))
+            ids_log.append(ids_arr.copy())
+            _feed_split(model, ids_arr, mask, done)
+            done += 1
+            if save_after is not None and done == save_after + 1:
+                save_rotating(
+                    ckpt_prefix, model.server, model.clients,
+                    scheduler_step=0, accountant=model.accountant,
+                    prev_change_words=model._prev_change_words,
+                    fingerprint=model.checkpoint_fingerprint,
+                    throughput=model.throughput.state_dict(),
+                    scheduler=model.scheduler_state(),
+                    sampler=model.sampler_state(),
+                    async_admit=model.async_admit_state(),
+                    client_rows=model.client_rows_payload())
+            if done >= total_rounds:
+                break
+        if done >= total_rounds:
+            break
+    return ids_log
+
+
+def _server_bits(model):
+    return [np.asarray(l) for l in model.server]
+
+
+def _control_trajectory(jpath):
+    """{(controller, round): (old, new, clamped)} from a journal —
+    replays re-journal DUPLICATE-BUT-IDENTICAL events (the screen
+    controller's shipped semantics), so last-wins is well-defined."""
+    out = {}
+    for line in open(jpath):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("event") == "control":
+            out[(rec["controller"], rec["round"])] = (
+                rec["old"], rec["new"], rec["clamped"])
+    return out
+
+
+class _FakeTracker:
+    def __init__(self, rates):
+        self.rates = np.asarray(rates, np.float64)
+
+    def examples_per_sec(self, ids=None):
+        return self.rates
+
+
+class _NullTracker:
+    """Absorbs a TelemetrySession's wall-clock rate feeds so the
+    deterministic _feed_split stream stays the throughput tracker's
+    ONLY input (attach_telemetry points a tracker-less session at
+    model.throughput, which would mix real span timings in)."""
+
+    def update_round(self, *args, **kwargs):
+        pass
+
+
+# ---------------- unit: speed matching -----------------------------------
+
+def test_speed_match_flags_slow_and_tightens():
+    cfg = _cfg(**CTL_KW)
+    ctl = SpeedMatchController(cfg)
+    assert ctl.plan_value() == np.float32(0.5)
+    ids = np.arange(W)
+    ex = np.full(W, float(B))
+    # rates [1,1,1,4,4,4,4,4]: median 4, threshold 0.5*4=2 -> 3 slow
+    # of 8 active = signal 0.375 > target 0.25 -> tighten to 0.4
+    tracker = _FakeTracker([1, 1, 1, 4, 4, 4, 4, 4])
+    value, work, adj = ctl.stamp(3, ids, ex, tracker)
+    want = float(np.float32(0.5 / 1.25))
+    assert value == want and ctl.plan_value() == want
+    assert adj == Adjustment("speed_match", 3, 0.375,
+                             float(np.float32(0.5)), want, False)
+    # post-adjust threshold 0.4*4=1.6: the three rate-1 clients stay
+    # flagged at work max(1/4, 0.25) = 0.25; fast clients keep 1.0
+    assert work.dtype == np.float32
+    np.testing.assert_allclose(work[:3], 0.25)
+    np.testing.assert_array_equal(work[3:], 1.0)
+
+
+def test_speed_match_loosens_and_clamps():
+    cfg = _cfg(**CTL_KW)
+    ctl = SpeedMatchController(cfg)
+    ids, ex = np.arange(W), np.full(W, float(B))
+    # uniform rates: nobody below ratio*median -> signal 0 < target
+    # -> loosen every stamp until the hi clamp reports clamped=True
+    tracker = _FakeTracker(np.full(W, 2.0))
+    clamps = []
+    for r in range(12):
+        _, work, adj = ctl.stamp(r, ids, ex, tracker)
+        assert work is None
+        if adj is not None:
+            clamps.append(adj.clamped)
+    assert ctl.plan_value() == np.float32(cfg.speed_ratio_max)
+    assert clamps[-1] is True and not any(clamps[:-1])
+    # median-threshold rule: ratio <= max < 1 flags at most half the
+    # measured cohort — a round can never defer itself empty
+    rates = np.array([1, 1, 1, 1, 4, 4, 4, 4], float)
+    _, work, _ = ctl.stamp(99, ids, ex, _FakeTracker(rates))
+    assert work is not None and int((work < 1.0).sum()) <= W // 2
+
+
+def test_speed_match_needs_two_measured():
+    cfg = _cfg(**CTL_KW)
+    ctl = SpeedMatchController(cfg)
+    ids, ex = np.arange(W), np.full(W, float(B))
+    # one measured client: no median, no observation, value unchanged
+    value, work, adj = ctl.stamp(
+        0, ids, ex, _FakeTracker([2.0] + [0.0] * (W - 1)))
+    assert (value, work, adj) == (float(np.float32(0.5)), None, None)
+    assert ctl.rounds_observed == 0
+
+
+# ---------------- unit: span cadence -------------------------------------
+
+def test_span_cadence_warmup_then_argmin():
+    cfg = _cfg(scan_rounds=True, scan_span_palette="4,1,2")
+    ctl = SpanCadenceController(cfg)
+    assert ctl.palette == (1, 2, 4)  # parsed ascending, deduped
+    assert ctl.plan_value() == 1
+    # warmup cycles untried entries in palette order
+    adj = ctl.feed_span(0, 1, 1.0)
+    assert (adj.old, adj.new) == (1.0, 2.0) and adj.clamped is False
+    adj = ctl.feed_span(1, 2, 4.0)
+    assert (adj.old, adj.new) == (2.0, 4.0)
+    # last warmup feed: every entry tried, argmin EMA takes over —
+    # entry 4 at 0.5 s/round wins, pick stays 4 => no adjustment
+    assert ctl.feed_span(2, 4, 2.0) is None
+    np.testing.assert_allclose(ctl.ema, [1.0, 2.0, 0.5])
+    # a slow span moves entry 4's EMA to 1.25: argmin flips to 1
+    adj = ctl.feed_span(3, 4, 8.0)
+    assert (adj.old, adj.new) == (4.0, 1.0)
+    np.testing.assert_allclose(ctl.ema, [1.0, 2.0, 1.25])
+
+
+def test_span_cadence_tail_decomposition():
+    cfg = _cfg(scan_rounds=True, scan_span_palette="1,2,4")
+    ctl = SpanCadenceController(cfg)
+    assert ctl.tail_cap(7) == 4
+    assert ctl.tail_cap(3) == 2
+    assert ctl.tail_cap(1) == 1
+    assert ctl.tail_cap(0) == 1  # min-palette fallback
+    # off-palette span lengths feed no EMA entry but still count
+    assert ctl.feed_span(0, 3, 3.0) is None or True
+    assert np.isnan(ctl.ema).sum() >= 2
+
+
+def test_span_palette_config_validation():
+    with pytest.raises(ValueError, match="scan_rounds"):
+        _cfg(scan_span_palette="1,2")
+    with pytest.raises(ValueError, match="include 1"):
+        _cfg(scan_rounds=True, scan_span_palette="2,4")
+    with pytest.raises(ValueError, match="positive"):
+        _cfg(scan_rounds=True, scan_span_palette="1,-2")
+    with pytest.raises(ValueError, match="scan_span"):
+        _cfg(scan_rounds=True, scan_span=2, scan_span_palette="1,2")
+    assert _cfg(scan_rounds=True,
+                scan_span_palette="1,2").span_palette == (1, 2)
+
+
+# ---------------- unit: staleness decay ----------------------------------
+
+def test_staleness_decay_tightens_loosens_clamps():
+    cfg = _cfg(**CTL_KW)
+    ctl = StalenessDecayController(cfg)
+    assert ctl.lag == 1  # per-round synchronous loop
+    start = float(np.float32(ctl.decay))
+    assert ctl.observe_commit(0, {}) is None  # metrics off: no-op
+    adj = ctl.observe_commit(1, {"estimate_residual": 0.9})
+    assert adj.new == float(np.float32(start / 1.25))
+    assert adj.new < start and adj.clamped is False
+    adj = ctl.observe_commit(2, {"estimate_residual": 0.0})
+    assert adj.new > adj.old
+    # loosen to the hi clamp
+    last = None
+    for r in range(3, 20):
+        a = ctl.observe_commit(r, {"estimate_residual": 0.0})
+        last = a or last
+    assert float(np.float32(ctl.decay)) == np.float32(
+        cfg.staleness_decay_max)
+    assert last.clamped is True
+
+
+def test_staleness_stamp_is_fixed_lag():
+    """The stamped wire value for round r is the post-commit decay at
+    r - lag — NOT the live fold tail — so the stamped trajectory is a
+    pure function of per-round signals, invariant to how far staging
+    runs ahead of commits (span decomposition, --pipeline depth)."""
+    cfg = _cfg(**CTL_KW, pipeline=True, scan_rounds=True,
+               checkpoint_every=1, scan_span_palette="1,2")
+    ctl = StalenessDecayController(cfg)
+    assert ctl.lag == 4  # 2 x max(palette) under --pipeline
+    init = ctl.plan_value()
+    decays = {}
+    for r in range(8):
+        ctl.observe_commit(r, {"estimate_residual": 0.0})
+        decays[r] = float(np.float32(ctl.decay))
+    for r in range(12):
+        value, work, adj = ctl.stamp(r, None, None, None)
+        assert work is None and adj is None
+        want = init if r < 4 else decays[r - 4]
+        assert value == want
+    # install records the plan-carried value without touching the fold
+    tail = ctl.decay
+    ctl.install(0.123)
+    assert ctl.plan_value() == float(np.float32(0.123))
+    assert ctl.decay == tail
+    # ring prunes to the lookup horizon but keeps it reachable
+    assert len(ctl.ring) <= 4 * ctl.lag + 4
+
+
+# ---------------- the bank -----------------------------------------------
+
+def test_bank_rejects_unregistered_and_colliding_fields():
+    class Rogue(Controller):
+        NAME = "rogue"
+        WIRE_FIELD = "rogue_knob"
+
+        def plan_value(self):
+            return 1.0
+
+        def install(self, value):
+            pass
+
+    with pytest.raises(ValueError, match="CONTROL_FIELDS"):
+        ControllerBank([Rogue()])
+    cfg = _cfg(**CTL_KW)
+    with pytest.raises(ValueError, match="share wire field"):
+        ControllerBank([SpeedMatchController(cfg),
+                        SpeedMatchController(cfg)])
+
+
+def test_bank_stamp_min_composes_work_and_installs():
+    cfg = _cfg(**CTL_KW)
+    bank = ControllerBank([SpeedMatchController(cfg),
+                           StalenessDecayController(cfg)])
+    plan = RoundPlan(5, W, None, np.full(W, 0.2, np.float32), None,
+                     None, None, "throughput")
+    ids, ex = np.arange(W), np.full(W, float(B))
+    stamped = bank.stamp_plan(plan, ids, ex,
+                              _FakeTracker([1, 1, 1, 4, 4, 4, 4, 4]))
+    assert set(stamped.controls) == {"speed_ratio", "staleness_decay"}
+    # pre-existing work 0.2 beats the speed matcher's 0.25 (min wins)
+    np.testing.assert_allclose(stamped.work, 0.2)
+    assert len(bank.take_events()) == 1  # the speed adjustment
+    assert bank.take_events() == []      # drained
+    # install adopts plan values verbatim (plan always wins); the
+    # staleness fold tail is commit-fed only, so install records the
+    # plan value without rewriting history
+    bank.install({"speed_ratio": 0.33, "staleness_decay": 0.77,
+                  "unknown_field": 9.9})
+    assert bank.controllers[0].ratio == 0.33
+    assert bank.controllers[1].plan_value() == float(np.float32(0.77))
+    assert bank.controllers[1].decay != 0.77
+
+
+def test_bank_state_roundtrip_under_ctl_namespace():
+    cfg = _cfg(**CTL_KW, scan_rounds=True, scan_span_palette="1,2")
+    bank = make_bank(cfg)
+    assert bank.names == ["speed_match", "span_cadence",
+                          "staleness_decay"]
+    bank.controllers[0].ratio = 0.37
+    bank.controllers[1].feed_span(0, 1, 1.0)
+    bank.controllers[2].decay = 0.66
+    state = bank.state_dict()
+    assert "ctl_speed_match_ratio" in state
+    assert "ctl_span_cadence_ema" in state
+    bank2 = make_bank(cfg)
+    bank2.load_state_dict(state)
+    assert bank2.controllers[0].ratio == 0.37
+    assert bank2.controllers[1].choice == bank.controllers[1].choice
+    np.testing.assert_array_equal(bank2.controllers[1].ema,
+                                  bank.controllers[1].ema)
+    assert bank2.controllers[2].decay == 0.66
+    # legacy state (no ctl_* keys): config-derived start survives
+    bank3 = make_bank(cfg)
+    bank3.load_state_dict({"sched_rounds_scheduled": 4})
+    assert bank3.controllers[0].ratio == np.float32(cfg.speed_ratio)
+
+
+def test_make_bank_default_is_none():
+    assert make_bank(_cfg()) is None
+    model, _ = _fed_model(_cfg())
+    assert model.control_bank is None
+
+
+# ---------------- screen controller migration ----------------------------
+
+def test_screen_controller_is_the_scheduler_export():
+    from commefficient_tpu.scheduler import (
+        AdaptiveScreenController as SchedExport,
+    )
+    assert SchedExport is AdaptiveScreenController
+    assert issubclass(AdaptiveScreenController, Controller)
+
+
+def test_screen_migration_golden_trajectory():
+    """The pre-migration (PR 17) f32 step/clamp arithmetic, recomputed
+    inline: feeding the same observation stream must reproduce the
+    identical mult trajectory AND the identical (old, new, rate)
+    journal payloads — the screen_adapt stream a pre-20 build wrote."""
+    cfg = _cfg(update_screen="norm", screen_norm_mult=3.0,
+               target_screened_rate=0.25, screen_adapt_step=0.5,
+               screen_mult_min=1.5, screen_mult_max=10.0)
+    ctl = AdaptiveScreenController(cfg)
+    stream = [(4, 8), (0, 8), (0, 8), (2, 8), (8, 8), (0, 8), (2, 8)]
+    got = [ctl.observe(r, s, c) for r, (s, c) in enumerate(stream)]
+
+    mult, want = 3.0, []
+    for n_screened, n_cohort in stream:
+        rate = n_screened / n_cohort
+        if rate > 0.25:
+            new = min(mult * 1.5, 10.0)
+        elif rate < 0.25:
+            new = max(mult / 1.5, 1.5)
+        else:
+            new = mult
+        new = float(np.float32(new))
+        want.append(None if new == mult else (mult, new, rate))
+        mult = new
+    assert got == want
+    assert ctl.plan_mult() == mult
+    # legacy checkpoint keys survive the migration (pre-20 restores)
+    state = ctl.state_dict()
+    assert set(state) == {"screen_mult", "screen_rounds_observed"}
+    ctl2 = AdaptiveScreenController(cfg)
+    ctl2.load_state_dict(state)
+    assert ctl2.plan_mult() == ctl.plan_mult()
+    assert ctl2.rounds_observed == len(stream)
+
+
+# ---------------- plan wire: controls ride conditionally -----------------
+
+def test_plan_controls_serialize_roundtrip_and_default_bytes():
+    bare = RoundPlan(0, W, None, None, None, None, None, "uniform")
+    wire = serialize_plan(bare)
+    assert b"controls" not in wire  # pre-20 byte-identity
+    rich = bare._replace(controls={
+        "speed_ratio": float(np.float32(1 / 3)),
+        "scan_span": 4,
+        "staleness_decay": float(np.float32(0.7))})
+    back = deserialize_plan(serialize_plan(rich))
+    assert back.controls == rich.controls
+    assert isinstance(back.controls["scan_span"], int)
+    # journal_fields surfaces the controls in the schedule event
+    jf = rich.journal_fields() if hasattr(rich, "journal_fields") else {}
+    if jf:
+        assert jf.get("scan_span") == 4
+
+
+# ---------------- replay: crash -> resume (per-round path) ---------------
+
+def test_controllers_crash_resume_bit_exact(tmp_path):
+    """speed_match + adapt_staleness live across an injected crash:
+    the resumed run reproduces bit-identical weights and the identical
+    per-controller adjustment trajectory (journal-compared)."""
+    R, K = 6, 3
+    cfg = _cfg(**CTL_KW)
+    pool = _client_pool()
+
+    ja = str(tmp_path / "a.jsonl")
+    model_a, _ = _fed_model(cfg)
+    smp_a = _attach_single(model_a)
+    tele_a = TelemetrySession(journal=RunJournal(ja),
+                              tracker=model_a.throughput,
+                              clock=lambda: 0.0)
+    model_a.attach_telemetry(tele_a)
+    tele_a.journal_event("run_start")  # segment marker, as drivers write
+    ids_a = _drive(model_a, smp_a, pool, R)
+    tele_a.close()
+    traj_a = _control_trajectory(ja)
+    assert any(c == "speed_match" for c, _ in traj_a)
+    assert any(c == "staleness_decay" for c, _ in traj_a)
+
+    jb = str(tmp_path / "b.jsonl")
+    prefix = str(tmp_path / "ck" / "m")
+    model_b, _ = _fed_model(cfg)
+    smp_b = _attach_single(model_b)
+    model_b.set_fault_schedule(FaultSchedule(crash_after=K))
+    tele_b = TelemetrySession(journal=RunJournal(jb),
+                              tracker=model_b.throughput,
+                              clock=lambda: 0.0)
+    model_b.attach_telemetry(tele_b)
+    tele_b.journal_event("run_start")  # segment marker, as drivers write
+    with pytest.raises(InjectedFault):
+        _drive(model_b, smp_b, pool, R, save_after=1,
+               ckpt_prefix=prefix)
+    tele_b.close()
+
+    model_c, _ = _fed_model(cfg)
+    smp_c = _attach_single(model_c)
+    tele_c = TelemetrySession(journal=RunJournal(jb),
+                              tracker=model_c.throughput,
+                              clock=lambda: 0.0)
+    model_c.attach_telemetry(tele_c)
+    tele_c.journal_event("run_start")  # segment marker, as drivers write
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None
+    model_c.load_state(ckpt)
+    done = int(np.asarray(ckpt.server.round_idx))
+    assert done == 2
+    ids_c = _drive(model_c, smp_c, pool, R, start=done)
+    tele_c.close()
+
+    np.testing.assert_array_equal(np.stack(ids_a[done:]),
+                                  np.stack(ids_c))
+    for a, b in zip(_server_bits(model_a), _server_bits(model_c)):
+        np.testing.assert_array_equal(a, b)
+    # identical adjustment trajectory — the replayed rounds'
+    # duplicate-but-identical events collapse under last-wins
+    assert _control_trajectory(jb) == traj_a
+    # the journal (crash + resume segments) validates, control
+    # events included, and the summary surfaces both controllers
+    records, problems = validate_journal(jb)
+    assert problems == []
+    ctls = summarize(records)["controllers"]
+    assert set(ctls) == {"speed_match", "staleness_decay"}
+    assert all(v["adjustments"] >= 1 for v in ctls.values())
+
+
+# ---------------- replay: emulated coordinator takeover ------------------
+
+def test_controllers_takeover_bit_exact(tmp_path):
+    """Coordinator dies mid-run with both per-round controllers live;
+    the promoted follower loads the shared checkpoint, replays against
+    the write-ahead plan journal (controller values plan-carried,
+    work fractions digest-covered), and finishes bit-exact."""
+    R = 6
+    jpath = str(tmp_path / "journal.jsonl")
+    prefix = str(tmp_path / "ckpt" / "model")
+    cfg = _cfg(**CTL_KW)
+    pool = _client_pool()
+
+    model_a, _ = _fed_model(cfg)
+    smp_a, _, _ = _attach_emulated(model_a, num=3)
+    ids_a = _drive(model_a, smp_a, pool, R)
+    final_ratio = model_a.control_bank.controllers[0].plan_value()
+    final_decay = model_a.control_bank.controllers[1].plan_value()
+
+    model_b, _ = _fed_model(cfg)
+    sched = FaultSchedule(coordinator_crash_at=4)
+    smp_b, mirror_b, net = _attach_emulated(model_b, num=3,
+                                            schedule=sched)
+    tele_b = TelemetrySession(journal=RunJournal(jpath),
+                              tracker=model_b.throughput,
+                              clock=lambda: 0.0)
+    model_b.attach_telemetry(tele_b)
+    tele_b.journal_event("run_start")  # segment marker, as drivers write
+    with pytest.raises(InjectedFault):
+        _drive(model_b, smp_b, pool, R, save_after=1,
+               ckpt_prefix=prefix)
+    tele_b.close()
+    assert 0 in net.dead
+
+    assert net.promote() == 1
+    net.schedule = None
+    model_c, _ = _fed_model(cfg)
+    smp_c, mirror_c, _ = _attach_emulated(model_c, network=net)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None
+    model_c.load_state(ckpt)
+    model_c.load_plan_stream(jpath)
+    done = int(np.asarray(ckpt.server.round_idx))
+    ids_c = _drive(model_c, smp_c, pool, R, start=done)
+
+    np.testing.assert_array_equal(np.stack(ids_a[done:]),
+                                  np.stack(ids_c))
+    for a, b in zip(_server_bits(model_a), _server_bits(model_c)):
+        np.testing.assert_array_equal(a, b)
+    # the promoted controller's bank landed on the same final values —
+    # the replay reproduced the trajectory, digest-checked per round
+    assert model_c.control_bank.controllers[0].plan_value() \
+        == final_ratio
+    assert model_c.control_bank.controllers[1].plan_value() \
+        == final_decay
+    # the write-ahead schedule events carried the controller values
+    stamped = [json.loads(l) for l in open(jpath)]
+    sched_evs = [r for r in stamped if r.get("event") == "schedule"]
+    assert any("speed_ratio" in r for r in sched_evs)
+    assert any("staleness_decay" in r for r in sched_evs)
+
+
+# ---------------- replay: span cadence under --pipeline ------------------
+
+def test_span_cadence_pipeline_crash_resume_bit_exact(tmp_path):
+    """All three controllers under the PIPELINED scanned path with an
+    adaptive span palette: a mid-run crash resumes from the span
+    boundary to bit-identical weights (weights are span-decomposition-
+    invariant, so the post-crash cadence EMAs are free to keep
+    learning), the journal validates, and every controller adjusted at
+    least once."""
+    from commefficient_tpu.training.scanloop import (
+        make_span_checkpoint, run_scanned_rounds,
+    )
+    from commefficient_tpu.utils.schedules import LambdaLR
+
+    R = 8
+    prefix = str(tmp_path / "pipe" / "model")
+    cfg = _cfg(**CTL_KW, pipeline=True, checkpoint_every=1,
+               ckpt_every_spans=1, scan_rounds=True,
+               scan_span_palette="1,2")
+    pool = _client_pool()
+
+    def scan_drive(model, smp, total, start=0, checkpoint=None):
+        x, y = pool
+        done = [start]
+
+        def stream():
+            while done[0] < total:
+                if model.scheduler is not None:
+                    model.scheduler.begin_epoch(done[0])
+                for ids, idx, mask in smp.epoch():
+                    ids_arr = np.asarray(ids)
+                    _feed_split(model, ids_arr, mask, done[0])
+                    yield (done[0], ids_arr,
+                           (x[ids_arr[:, None], idx],
+                            y[ids_arr[:, None], idx]), mask, 0.1)
+                    done[0] += 1
+                    if done[0] >= total:
+                        return
+
+        def emit(tag, loss_w, aux_w):
+            return True
+
+        return run_scanned_rounds(model, stream(),
+                                  model.control_bank, emit,
+                                  checkpoint=checkpoint,
+                                  pipeline=True)
+
+    ja = str(tmp_path / "a.jsonl")
+    model_a, _ = _fed_model(cfg)
+    smp_a = _attach_single(model_a)
+    # the journaling arm must not ALSO feed the tracker real span
+    # wall-times (the crash/resume arms carry no telemetry, so their
+    # tracker sees only the synthetic _feed_split stream — the arms
+    # must share one feeding regime to compare weights)
+    tele_a = TelemetrySession(journal=RunJournal(ja),
+                              tracker=_NullTracker())
+    model_a.attach_telemetry(tele_a)
+    tele_a.journal_event("run_start")  # segment marker, as drivers write
+    assert scan_drive(model_a, smp_a, R)
+    tele_a.close()
+    want = _server_bits(model_a)
+    model_a.close_persistence()
+    records, problems = validate_journal(ja)
+    assert problems == []
+    ctls = summarize(records)["controllers"]
+    assert set(ctls) == {"span_cadence", "speed_match",
+                         "staleness_decay"}
+    assert all(v["adjustments"] >= 1 for v in ctls.values())
+
+    model_b, opt_b = _fed_model(cfg)
+    smp_b = _attach_single(model_b)
+    model_b.set_fault_schedule(FaultSchedule(crash_after=4))
+    lr_b = LambdaLR(opt_b, lr_lambda=lambda s: 1.0)
+    hook = make_span_checkpoint(prefix, model_b, cfg, lr_b)
+    with pytest.raises(InjectedFault):
+        scan_drive(model_b, smp_b, R, checkpoint=hook)
+    model_b.close_persistence()
+
+    model_c, _ = _fed_model(cfg)
+    smp_c = _attach_single(model_c)
+    ckpt = load_latest(prefix,
+                       expect_fingerprint=model_c.checkpoint_fingerprint)
+    assert ckpt is not None
+    model_c.load_state(ckpt)
+    done = int(np.asarray(ckpt.server.round_idx))
+    assert 0 < done <= 5
+    assert scan_drive(model_c, smp_c, R, start=done)
+    for a, b in zip(want, _server_bits(model_c)):
+        np.testing.assert_array_equal(a, b)
+    model_c.close_persistence()
+
+
+# ---------------- scanloop: adaptive cap mechanics -----------------------
+
+def test_scanloop_static_cap_unchanged_and_adaptive_tail():
+    """A plain-int span_cap flushes exactly as before (one leftover
+    tail span); an adaptive provider latches its pick per span and
+    greedily decomposes the tail over the palette."""
+    from commefficient_tpu.training.scanloop import run_scanned_rounds
+
+    class _Model:
+        def run_rounds(self, ids, data, mask, lrs):
+            lens.append(len(ids))
+            n = len(ids)
+            return [np.zeros((n, 1)), np.zeros((n, 1)), 0.0, 0.0]
+
+    def _stream(n):
+        for i in range(n):
+            yield (i, [i], ((np.zeros(1),),), np.ones(1), 0.1)
+
+    def emit(tag, loss_w, aux_w):
+        return True
+
+    lens = []
+    assert run_scanned_rounds(_Model(), _stream(7), 3, emit)
+    assert lens == [3, 3, 1]  # static: leftover tail is its own span
+
+    class _Caps:
+        def __init__(self, picks, palette):
+            self.picks, self.palette = list(picks), palette
+
+        def span_cap(self, default):
+            return self.picks.pop(0) if self.picks else default
+
+        def tail_cap(self, leftover):
+            return max([p for p in self.palette if p <= leftover],
+                       default=min(self.palette))
+
+    lens = []
+    # picks 4, 2, 4: the third span latches 4 but only 3 rounds
+    # remain, so the tail decomposes 2+1 over palette (1, 2, 4)
+    assert run_scanned_rounds(_Model(), _stream(9),
+                              _Caps([4, 2, 4], (1, 2, 4)), emit)
+    assert lens == [4, 2, 2, 1]

@@ -350,6 +350,40 @@ GL013_NEG = """
         return (preds == labels) & (labels != ignore)
 """
 
+GL014_POS = """
+    from commefficient_tpu.control.base import Controller
+
+    class RogueController(Controller):
+        NAME = "rogue"
+        # claims a plan wire field the CONTROL_FIELDS registry has
+        # never heard of — bypasses the uniqueness assert
+        WIRE_FIELD = "rogue_knob"
+
+        def plan_value(self):
+            return 1.0
+
+        def install(self, value):
+            pass
+"""
+GL014_NEG = """
+    from commefficient_tpu.control.base import Controller
+
+    class PoliteController(Controller):
+        NAME = "speed_match"
+        # a registered CONTROL_FIELDS value is the sanctioned idiom
+        WIRE_FIELD = "speed_ratio"
+
+        def plan_value(self):
+            return 0.5
+
+        def install(self, value):
+            pass
+
+    class AbstractBase(Controller):
+        # the base-class empty sentinel is not a field claim
+        WIRE_FIELD = ""
+"""
+
 # rule -> (positive, negative[, lint path]); GL010 is path-scoped to
 # the packages that construct shardings, so its fixtures lint under a
 # parallel/ path (everything else uses the default snippet.py)
@@ -368,6 +402,7 @@ FIXTURES = {
     "GL011": (GL011_POS, GL011_NEG),
     "GL012": (GL012_POS, GL012_NEG),
     "GL013": (GL013_POS, GL013_NEG),
+    "GL014": (GL014_POS, GL014_NEG),
 }
 
 
@@ -398,6 +433,39 @@ def test_gl009_shipped_registry_is_unique():
     assert DOMAINS["dropout"] == 0x0D120
     assert DOMAINS["straggler"] == 0x51044
     assert DOMAINS["sampler"] == 0x5C4ED
+
+
+def test_gl014_registry_collision_is_flagged():
+    """Two controllers registered onto ONE wire field inside the
+    CONTROL_FIELDS dict is a GL014 hit — but only when linting the
+    registry file's path (the pure-AST twin of the import-time
+    uniqueness assert)."""
+    src = """
+        CONTROL_FIELDS = {
+            "screen_adapt": "screen_mult",
+            "speed_match": "speed_ratio",
+            "span_cadence": "speed_ratio",
+        }
+    """
+    vs = lint_source("commefficient_tpu/analysis/domains.py",
+                     textwrap.dedent(src))
+    assert [v.rule for v in vs] == ["GL014"]
+    assert "collision" in vs[0].message
+    # same dict under any other path is nobody's registry
+    assert codes(src) == []
+
+
+def test_gl014_shipped_registry_is_unique():
+    from commefficient_tpu.analysis.domains import CONTROL_FIELDS
+    assert len(set(CONTROL_FIELDS.values())) == len(CONTROL_FIELDS)
+    # every shipped controller's (NAME, WIRE_FIELD) pair is registered
+    from commefficient_tpu.control import (
+        AdaptiveScreenController, SpanCadenceController,
+        SpeedMatchController, StalenessDecayController,
+    )
+    for ctl in (AdaptiveScreenController, SpeedMatchController,
+                SpanCadenceController, StalenessDecayController):
+        assert CONTROL_FIELDS[ctl.NAME] == ctl.WIRE_FIELD
 
 
 def _fixture_codes(src: str, path: str = "snippet.py"):
